@@ -1,0 +1,15 @@
+"""distributed_pipeline_tpu — a TPU-native (JAX/XLA/pjit/pallas) training framework
+with the capabilities of the reference torch.distributed pipeline scaffold.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+  config/    typed pydantic<->argparse<->JSON settings
+  parallel/  distributed substrate: jax.distributed init, device mesh,
+             sharding specs, launcher, ring attention
+  utils/     trainer (single jitted train_step), logger, checkpointing, perf
+  data/      host-sharded infinite data pipeline with device prefetch
+  models/    DiffuSeq seq2seq diffusion + GPT-2 causal LM (flax.linen)
+  ops/       pallas TPU kernels for the hot ops
+  run/       CLI entry points
+"""
+
+__version__ = "0.1.0"

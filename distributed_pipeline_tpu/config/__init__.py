@@ -1,0 +1,18 @@
+from .base import (
+    ArgparseCompatibleBaseModel,
+    S,
+    Setting,
+    C,
+    choice,
+    item,
+    _,
+    bool_from_string,
+)
+from .train import (
+    DataSettings,
+    GeneralSettings,
+    MeshSettings,
+    ModelSettings,
+    TrainSettings,
+    YourSettings,
+)
