@@ -1,0 +1,16 @@
+from . import dist
+from .dist import (
+    barrier,
+    broadcast,
+    dev,
+    device_count,
+    find_free_port,
+    get_rank,
+    get_world_size,
+    is_available,
+    is_initialized,
+    setup_dist,
+    sync_params,
+)
+from .launcher import parse_and_autorun, parse_distributed_args
+from .mesh import AXES, batch_spec, make_mesh, resolve_axis_sizes

@@ -1,0 +1,184 @@
+"""Distributed substrate: the degrade-gracefully communication shim.
+
+Capability parity with the reference comm shim (``/root/reference/basic_utils/
+dist_util.py:26-159``): the same call sites work under a multi-host launch,
+a bare single process, or CPU-only — every primitive degrades to a no-op /
+identity without a cluster (reference contract analyzed in SURVEY.md §2.3).
+
+TPU-native mapping (no NCCL/c10d; XLA emits all collectives):
+
+==========================  ====================================================
+reference (torch/c10d)      this module (JAX)
+==========================  ====================================================
+``setup_dist``              ``setup_dist`` -> ``jax.distributed.initialize``
+                            (once-only, skipped for single-process)
+``is_available``            coordinator env vars present / multi-process flags
+``is_initialized``          ``jax.distributed`` client state
+``get_rank``                ``jax.process_index()`` (0 fallback)
+``get_world_size``          ``jax.process_count()`` (1 fallback)
+``barrier``                 ``multihost_utils.sync_global_devices``
+``dev``                     first addressable device (TPU chip or CPU)
+``broadcast``/``sync_params``  ``multihost_utils.broadcast_one_to_all``
+``load_state_dict``         checkpoint loading lives in utils/checkpoint.py
+``find_free_port``          same
+==========================  ====================================================
+
+Gradient all-reduce has no explicit call here at all: it is emitted by XLA
+from the ``NamedSharding`` of the jitted train step (replacing DDP's bucketed
+NCCL all-reduce, reference trainer.py:115-128).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import socket
+from typing import Any, Optional
+
+__all__ = [
+    "is_available",
+    "is_initialized",
+    "setup_dist",
+    "get_rank",
+    "get_world_size",
+    "barrier",
+    "dev",
+    "device_count",
+    "broadcast",
+    "sync_params",
+    "find_free_port",
+    "AUTORUN_ENV_FLAG",
+]
+
+# Set by the launcher on spawned workers (reference DIST_UTIL_AUTORUN_FLAG,
+# dist_run.py:312).
+AUTORUN_ENV_FLAG = "DPT_DIST_AUTORUN"
+
+_COORD_VARS = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+
+
+def is_available() -> bool:
+    """True when a multi-process launch is detectable from the environment
+    (reference dist_util.py:26-45 — torchrun env vars; here: JAX coordinator
+    vars or the launcher's autorun flag). Single-process runs return False and
+    everything still works."""
+    if getattr(is_available, "cache", None) is not None:
+        return is_available.cache  # type: ignore[attr-defined]
+    if os.environ.get(AUTORUN_ENV_FLAG):
+        return True
+    if any(v in os.environ for v in _COORD_VARS):
+        return True
+    if int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+        return True
+    return False
+
+
+def is_initialized() -> bool:
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)  # once-only, like reference's lru_cache (dist_util.py:57)
+def setup_dist(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX if a cluster is detectable; otherwise degrade
+    silently to single-process (reference dist_util.py:57-85 catches init
+    failure and downgrades). Idempotent via ``lru_cache``."""
+    import jax
+
+    if is_initialized():
+        return
+    if not is_available() and coordinator_address is None:
+        return  # single-process mode: nothing to do, all fallbacks engage
+    try:
+        kwargs: dict = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        elif (addr := next((os.environ[v] for v in _COORD_VARS if v in os.environ),
+                           None)) is not None:
+            kwargs["coordinator_address"] = addr
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        elif "JAX_NUM_PROCESSES" in os.environ:
+            kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        elif "JAX_PROCESS_INDEX" in os.environ:
+            kwargs["process_id"] = int(os.environ["JAX_PROCESS_INDEX"])
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:  # degrade to single-process, like the reference
+        from ..utils import logger
+        logger.warn(f"jax.distributed.initialize failed ({e}); "
+                    "continuing single-process")
+
+
+def get_rank() -> int:
+    """Process index, 0 when not distributed (reference dist_util.py:92-95)."""
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    """Process count, 1 when not distributed (reference dist_util.py:98-101)."""
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync; no-op single-process (reference dist_util.py:104-106)."""
+    import jax
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+def dev() -> Any:
+    """The local accelerator device (reference dist_util.py:109-115 returns
+    ``cuda:{LOCAL_RANK}`` or cpu; JAX's per-process addressable device plays
+    that role)."""
+    import jax
+    return jax.local_devices()[0]
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def broadcast(tree: Any) -> Any:
+    """Broadcast a pytree from process 0 to all (reference dist_util.py:127-138).
+    Identity when single-process."""
+    import jax
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def sync_params(params: Any) -> Any:
+    """Make all hosts agree on parameters by broadcasting process 0's copy
+    (reference dist_util.py:141-152 does per-tensor broadcast; a single pytree
+    broadcast is the JAX equivalent)."""
+    return broadcast(params)
+
+
+def find_free_port() -> int:
+    """(reference dist_util.py:155-159)"""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind(("", 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]
+    finally:
+        s.close()

@@ -1,0 +1,91 @@
+"""Device-mesh construction: the TPU-native replacement for process groups.
+
+The reference's only notion of topology is the flat c10d world
+(``dist_util.py:92-101``) consumed by DDP (``trainer.py:115-128``). On TPU the
+equivalent first-class object is a ``jax.sharding.Mesh`` over the ICI torus,
+with named axes that the rest of the framework shards against:
+
+* ``data``     — data parallelism (DDP replacement; gradient psum rides ICI)
+* ``fsdp``     — parameter/optimizer sharding (ZeRO/FSDP equivalent;
+                 BASELINE.json config 5)
+* ``tensor``   — tensor parallelism (reserved axis, SURVEY.md §2.2)
+* ``sequence`` — sequence/context parallelism for ring attention
+                 (SURVEY.md §5.7 "leave a sequence mesh-axis name reserved")
+
+Axis sizes come from ``MeshSettings`` (config/train.py); ``-1`` means "all
+remaining devices". Multi-host meshes use ``mesh_utils.create_device_mesh``
+so the axis order maps DCN-outermost/ICI-innermost correctly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["AXES", "make_mesh", "resolve_axis_sizes", "batch_spec", "local_mesh_info"]
+
+AXES: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor")
+
+
+def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
+                       tensor: int = 1,
+                       n_devices: Optional[int] = None) -> Tuple[int, int, int, int]:
+    """Resolve ``-1`` axis sizes against the device count and validate the
+    product. Returns sizes in AXES order (data, fsdp, sequence, tensor)."""
+    n = n_devices if n_devices is not None else jax.device_count()
+    sizes = {"data": dp, "fsdp": fsdp, "sequence": sequence, "tensor": tensor}
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if unknown:
+        if n % fixed != 0:
+            raise ValueError(
+                f"device count {n} not divisible by fixed axes product {fixed}")
+        sizes[unknown[0]] = n // fixed
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(
+            f"mesh axes {sizes} multiply to {total}, but {n} devices are present")
+    return tuple(sizes[a] for a in AXES)  # type: ignore[return-value]
+
+
+def make_mesh(dp: int = -1, fsdp: int = 1, tensor: int = 1, sequence: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the framework mesh. Works for 1 device (all axes size 1 except
+    one) through multi-host pods; on real TPU slices
+    ``mesh_utils.create_device_mesh`` picks an ICI-contiguous layout."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    shape = resolve_axis_sizes(dp=dp, fsdp=fsdp, sequence=sequence,
+                               tensor=tensor, n_devices=n)
+    try:
+        from jax.experimental import mesh_utils
+        device_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except Exception:
+        device_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(device_array, AXES)
+
+
+def batch_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
+    """PartitionSpec for a [batch, seq, ...] array: batch over data+fsdp
+    (FSDP ranks still consume distinct data shards — ZeRO semantics), and
+    optionally seq over the sequence axis (ring attention)."""
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or None
+    if isinstance(batch_axes, tuple) and len(batch_axes) == 1:
+        batch_axes = batch_axes[0]
+    if seq_sharded and mesh.shape["sequence"] > 1:
+        return P(batch_axes, "sequence")
+    return P(batch_axes)
+
+
+def local_mesh_info(mesh: Mesh) -> str:
+    """Human-readable mesh summary for the launch log."""
+    return (f"mesh {dict(mesh.shape)} over {mesh.devices.size} devices "
+            f"({jax.process_count()} host(s), "
+            f"{len(jax.local_devices())} local device(s))")

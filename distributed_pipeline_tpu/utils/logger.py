@@ -1,0 +1,504 @@
+"""Rank-aware key-value metrics logger.
+
+Capability parity with the reference logger (``/root/reference/basic_utils/
+logger.py``, itself derived from the OpenAI-baselines logger): per-iteration
+``logkv``/``logkv_mean`` accumulation, multi-sink ``dumpkvs`` flush, level-gated
+text logging, a ``profile_kv`` wall-time context manager, and rank gating so
+only one process writes sinks (reference gates on ``LOCAL_RANK==0`` at
+logger.py:373-377; here we gate on ``jax.process_index()==0`` with an env-var
+fallback so the logger works before/without JAX initialization).
+
+Differences from the reference, on purpose:
+
+* ``wandb`` is an optional import (the reference imports it unconditionally at
+  logger.py:16, which breaks machines without it);
+* cross-process metric averaging uses a JAX ``psum``-based helper
+  (``distributed_mean``) instead of an MPI communicator;
+* TensorBoard output uses ``tensorboardX``/``tf`` only if importable.
+
+Sink formats: human-readable table, JSONL, CSV (with dynamic column migration,
+reference logger.py:124-139), TensorBoard (optional), wandb (optional).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime
+import json
+import os
+import os.path as osp
+import shutil
+import sys
+import tempfile
+import time
+import warnings
+from collections import defaultdict
+from typing import Any, Dict, IO, Iterable, List, Optional, Sequence, Union
+
+__all__ = [
+    "DEBUG", "INFO", "WARN", "ERROR", "DISABLED",
+    "logkv", "logkv_mean", "logkvs", "logkvs_mean", "dumpkvs", "getkvs",
+    "log", "debug", "info", "warn", "error",
+    "set_level", "get_dir", "record_tabular", "dump_tabular",
+    "profile_kv", "profile", "configure", "reset", "scoped_configure",
+    "Logger", "get_current", "make_output_format",
+]
+
+DEBUG = 10
+INFO = 20
+WARN = 30
+ERROR = 40
+DISABLED = 50
+
+
+def _process_index() -> int:
+    """Writer-rank detection without forcing JAX backend init.
+
+    Env vars cover the pre-init window (set by the launcher, see
+    parallel/launcher.py); after ``jax.distributed.initialize`` the authoritative
+    ``jax.process_index()`` is used.
+    """
+    for var in ("JAX_PROCESS_INDEX", "PROCESS_INDEX", "LOCAL_RANK", "RANK"):
+        if var in os.environ:
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                pass
+    try:
+        import jax
+        if jax._src.xla_bridge._backends:  # backend already up -> cheap & exact
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
+
+
+# --------------------------------------------------------------------- sinks
+
+class KVWriter:
+    def writekvs(self, kvs: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class SeqWriter:
+    def writeseq(self, seq: Iterable[str]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class HumanOutputFormat(KVWriter, SeqWriter):
+    """Aligned key | value table (reference logger.py:38-98), 30-char truncation."""
+
+    def __init__(self, filename_or_file: Union[str, IO]):
+        if isinstance(filename_or_file, str):
+            self.file = open(filename_or_file, "at")
+            self.own_file = True
+        else:
+            self.file = filename_or_file
+            self.own_file = False
+
+    @staticmethod
+    def _truncate(s: str) -> str:
+        return s[:27] + "..." if len(s) > 30 else s
+
+    def writekvs(self, kvs: Dict[str, Any]) -> None:
+        key2str = {}
+        for key, val in sorted(kvs.items()):
+            valstr = f"{val:<8.3g}" if hasattr(val, "__float__") else str(val)
+            key2str[self._truncate(key)] = self._truncate(valstr)
+        if not key2str:
+            warnings.warn("Tried to write empty key-value dict")
+            return
+        keywidth = max(map(len, key2str.keys()))
+        valwidth = max(map(len, key2str.values()))
+        dashes = "-" * (keywidth + valwidth + 7)
+        lines = [dashes]
+        for key, val in key2str.items():
+            lines.append(f"| {key}{' ' * (keywidth - len(key))} | "
+                         f"{val}{' ' * (valwidth - len(val))} |")
+        lines.append(dashes)
+        self.file.write("\n".join(lines) + "\n")
+        self.file.flush()
+
+    def writeseq(self, seq: Iterable[str]) -> None:
+        self.file.write(" ".join(map(str, seq)) + "\n")
+        self.file.flush()
+
+    def close(self) -> None:
+        if self.own_file:
+            self.file.close()
+
+
+class JSONOutputFormat(KVWriter):
+    """One JSON object per dump (JSONL), numpy/jax scalars coerced to float
+    (reference logger.py:101-113)."""
+
+    def __init__(self, filename: str):
+        self.file = open(filename, "at")
+
+    def writekvs(self, kvs: Dict[str, Any]) -> None:
+        out = {}
+        for k, v in kvs.items():
+            if hasattr(v, "dtype") or hasattr(v, "__float__"):
+                try:
+                    v = float(v)
+                except (TypeError, ValueError):
+                    v = str(v)
+            out[k] = v
+        self.file.write(json.dumps(out) + "\n")
+        self.file.flush()
+
+    def close(self) -> None:
+        self.file.close()
+
+
+class CSVOutputFormat(KVWriter):
+    """CSV with dynamic column addition: when a new key appears, the whole file
+    is rewritten with the widened header (reference logger.py:116-150)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.file = open(filename, "a+t")
+        self.keys: List[str] = []
+        self.sep = ","
+        # Recover keys from an existing file so resume appends consistently.
+        self.file.seek(0)
+        header = self.file.readline().strip("\n")
+        if header:
+            self.keys = header.split(self.sep)
+        self.file.seek(0, os.SEEK_END)
+
+    def writekvs(self, kvs: Dict[str, Any]) -> None:
+        extra_keys = sorted(set(kvs.keys()) - set(self.keys))
+        if extra_keys:
+            self.keys.extend(extra_keys)
+            self.file.seek(0)
+            lines = self.file.readlines()
+            self.file.seek(0)
+            self.file.truncate()
+            self.file.write(self.sep.join(self.keys) + "\n")
+            for line in lines[1:]:
+                self.file.write(line.rstrip("\n") + self.sep * len(extra_keys) + "\n")
+        elif not self.file.tell():
+            self.file.write(self.sep.join(self.keys) + "\n")
+        row = []
+        for key in self.keys:
+            v = kvs.get(key)
+            row.append("" if v is None else str(v))
+        self.file.write(self.sep.join(row) + "\n")
+        self.file.flush()
+
+    def close(self) -> None:
+        self.file.close()
+
+
+class TensorBoardOutputFormat(KVWriter):
+    """TensorBoard events via tensorboardX (optional; the reference reaches
+    into raw TF internals, logger.py:153-191 — tensorboardX is the clean
+    equivalent)."""
+
+    def __init__(self, log_dir: str):
+        from tensorboardX import SummaryWriter  # lazy; optional dep
+        self.writer = SummaryWriter(log_dir)
+        self.step = 1
+
+    def writekvs(self, kvs: Dict[str, Any]) -> None:
+        step = int(kvs.get("step", self.step))
+        for k, v in kvs.items():
+            if hasattr(v, "__float__"):
+                self.writer.add_scalar(k, float(v), step)
+        self.step = step + 1
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class WandbOutputFormat(KVWriter):
+    """wandb sink (optional import, unlike reference's hard import logger.py:16)."""
+
+    def __init__(self):
+        import wandb  # lazy; optional dep
+        self.wandb = wandb
+
+    def writekvs(self, kvs: Dict[str, Any]) -> None:
+        if self.wandb.run is not None:
+            self.wandb.log(dict(kvs))
+
+
+def make_output_format(fmt: str, ev_dir: str, log_suffix: str = "") -> KVWriter:
+    """Factory (reference logger.py:194-207)."""
+    os.makedirs(ev_dir, exist_ok=True)
+    if fmt == "stdout":
+        return HumanOutputFormat(sys.stdout)
+    if fmt == "log":
+        return HumanOutputFormat(osp.join(ev_dir, f"log{log_suffix}.txt"))
+    if fmt == "json":
+        return JSONOutputFormat(osp.join(ev_dir, f"progress{log_suffix}.json"))
+    if fmt == "csv":
+        return CSVOutputFormat(osp.join(ev_dir, f"progress{log_suffix}.csv"))
+    if fmt == "tensorboard":
+        return TensorBoardOutputFormat(osp.join(ev_dir, f"tb{log_suffix}"))
+    if fmt == "wandb":
+        return WandbOutputFormat()
+    raise ValueError(f"Unknown format specified: {fmt}")
+
+
+# ----------------------------------------------------------------- front end
+
+def logkv(key: str, val: Any) -> None:
+    """Log one key-value pair for this iteration (overwrite semantics)."""
+    get_current().logkv(key, val)
+
+
+def logkv_mean(key: str, val: Any) -> None:
+    """Log a value averaged over all calls between dumps (running mean)."""
+    get_current().logkv_mean(key, val)
+
+
+def logkvs(d: Dict[str, Any]) -> None:
+    for k, v in d.items():
+        logkv(k, v)
+
+
+def logkvs_mean(d: Dict[str, Any]) -> None:
+    for k, v in d.items():
+        logkv_mean(k, v)
+
+
+def dumpkvs() -> Dict[str, Any]:
+    """Flush accumulated key-values to all sinks; returns the dict
+    (reference keeps this return "for unit testing purposes", logger.py:372)."""
+    return get_current().dumpkvs()
+
+
+def getkvs() -> Dict[str, Any]:
+    return get_current().name2val
+
+
+def log(*args: Any, level: int = INFO) -> None:
+    get_current().log(*args, level=level)
+
+
+def debug(*args: Any) -> None:
+    log(*args, level=DEBUG)
+
+
+def info(*args: Any) -> None:
+    log(*args, level=INFO)
+
+
+def warn(*args: Any) -> None:
+    log(*args, level=WARN)
+
+
+def error(*args: Any) -> None:
+    log(*args, level=ERROR)
+
+
+def set_level(level: int) -> None:
+    get_current().set_level(level)
+
+
+def get_dir() -> Optional[str]:
+    """Directory the logger writes to (doubles as the checkpoint auto-resume
+    discovery dir, reference trainer.py:330-335)."""
+    return get_current().dir
+
+
+record_tabular = logkv
+dump_tabular = dumpkvs
+
+
+@contextlib.contextmanager
+def profile_kv(scopename: str, sync_fn=None):
+    """Accumulate wall time into ``wait_<scope>`` (reference logger.py:296-303).
+    ``sync_fn`` (e.g. ``jax.block_until_ready`` on a result) makes async device
+    work attributable to the scope."""
+    logkey = "wait_" + scopename
+    tstart = time.time()
+    try:
+        yield
+    finally:
+        if sync_fn is not None:
+            sync_fn()
+        get_current().name2val[logkey] += time.time() - tstart
+
+
+def profile(n: str):
+    """Decorator: profile_kv around every call (reference logger.py:306-320)."""
+    def decorator(func):
+        def wrapper(*args, **kwargs):
+            with profile_kv(n):
+                return func(*args, **kwargs)
+        wrapper.__name__ = getattr(func, "__name__", "wrapped")
+        return wrapper
+    return decorator
+
+
+# ------------------------------------------------------------------- backend
+
+class Logger:
+    CURRENT: Optional["Logger"] = None
+    DEFAULT: Optional["Logger"] = None
+
+    def __init__(self, dir: Optional[str], output_formats: Sequence[KVWriter],
+                 comm: Any = None):
+        self.name2val: Dict[str, float] = defaultdict(float)
+        self.name2cnt: Dict[str, int] = defaultdict(int)
+        self.level = INFO
+        self.dir = dir
+        self.output_formats = list(output_formats)
+        self.comm = comm  # optional distributed-mean hook (callable: dict->dict)
+
+    # kv API
+    def logkv(self, key: str, val: Any) -> None:
+        self.name2val[key] = val
+
+    def logkv_mean(self, key: str, val: Any) -> None:
+        oldval, cnt = self.name2val[key], self.name2cnt[key]
+        self.name2val[key] = oldval * cnt / (cnt + 1) + float(val) / (cnt + 1)
+        self.name2cnt[key] = cnt + 1
+
+    def dumpkvs(self) -> Dict[str, Any]:
+        if self.level == DISABLED:
+            return {}
+        d = dict(self.name2val)
+        if self.comm is not None:
+            d = self.comm(d)
+        if _process_index() == 0:
+            for fmt in self.output_formats:
+                if isinstance(fmt, KVWriter):
+                    fmt.writekvs(d)
+        self.name2val.clear()
+        self.name2cnt.clear()
+        return d
+
+    # text API
+    def log(self, *args: Any, level: int = INFO) -> None:
+        if self.level <= level:
+            self._do_log(args)
+
+    def set_level(self, level: int) -> None:
+        self.level = level
+
+    def set_comm(self, comm: Any) -> None:
+        self.comm = comm
+
+    def get_dir(self) -> Optional[str]:
+        return self.dir
+
+    def close(self) -> None:
+        for fmt in self.output_formats:
+            fmt.close()
+
+    def _do_log(self, args: Iterable[Any]) -> None:
+        for fmt in self.output_formats:
+            if isinstance(fmt, SeqWriter):
+                fmt.writeseq(map(str, args))
+
+
+def get_current() -> Logger:
+    if Logger.CURRENT is None:
+        _configure_default_logger()
+    return Logger.CURRENT  # type: ignore[return-value]
+
+
+def distributed_mean_comm():
+    """Returns a comm callable averaging numeric metrics across JAX processes
+    (replaces the reference's MPI ``mpi_weighted_mean``, logger.py:418-445).
+    Multi-host safe: uses ``multihost_utils.process_allgather``. No-op when
+    single-process."""
+    def comm(d: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+        if jax.process_count() == 1:
+            return d
+        import numpy as np
+        import zlib
+        from jax.experimental import multihost_utils
+        keys = sorted(k for k, v in d.items() if hasattr(v, "__float__"))
+        if not keys:
+            return d
+        # Ranks may log divergent key sets (rank-guarded metrics); a blind
+        # allgather would misalign values by index. Verify agreement first
+        # and fail safe to local values when key sets differ.
+        key_hash = np.array([zlib.crc32(",".join(keys).encode()), len(keys)],
+                            dtype=np.int64)
+        all_hashes = np.asarray(multihost_utils.process_allgather(key_hash))
+        if not (all_hashes == all_hashes[0]).all():
+            warnings.warn("distributed_mean: metric key sets differ across "
+                          "processes; skipping cross-process averaging")
+            return d
+        local = np.array([float(d[k]) for k in keys], dtype=np.float64)
+        gathered = multihost_utils.process_allgather(local)
+        mean = np.asarray(gathered).reshape(jax.process_count(), -1).mean(axis=0)
+        out = dict(d)
+        out.update({k: float(m) for k, m in zip(keys, mean)})
+        return out
+    return comm
+
+
+def configure(dir: Optional[str] = None, format_strs: Optional[Sequence[str]] = None,
+              comm: Any = None, log_suffix: str = "") -> None:
+    """Configure the global logger (reference logger.py:448-477).
+
+    Directory defaults to ``$OPENAI_LOGDIR`` or a dated tmp dir; non-zero
+    processes get a ``-rank%03i`` file suffix; formats default from
+    ``$OPENAI_LOG_FORMAT`` (writer rank) / ``$OPENAI_LOG_FORMAT_MPI`` (others).
+    """
+    if dir is None:
+        dir = os.getenv("OPENAI_LOGDIR")
+    if dir is None:
+        dir = osp.join(
+            tempfile.gettempdir(),
+            datetime.datetime.now().strftime("dpt-%Y-%m-%d-%H-%M-%S-%f"),
+        )
+    assert isinstance(dir, str)
+    dir = osp.expanduser(dir)
+    os.makedirs(osp.expanduser(dir), exist_ok=True)
+
+    rank = _process_index()
+    if rank > 0:
+        log_suffix = log_suffix + "-rank%03i" % rank
+    if format_strs is None:
+        if rank == 0:
+            format_strs = os.getenv("OPENAI_LOG_FORMAT", "stdout,log,csv").split(",")
+        else:
+            format_strs = os.getenv("OPENAI_LOG_FORMAT_MPI", "log").split(",")
+    format_strs = list(filter(None, format_strs))
+    output_formats = [make_output_format(f, dir, log_suffix) for f in format_strs]
+
+    Logger.CURRENT = Logger(dir=dir, output_formats=output_formats, comm=comm)
+    if output_formats:
+        log(f"Logging to {dir}")
+
+
+def _configure_default_logger() -> None:
+    configure(format_strs=["stdout"])
+    Logger.DEFAULT = Logger.CURRENT
+
+
+def reset() -> None:
+    if Logger.CURRENT is not Logger.DEFAULT:
+        if Logger.CURRENT is not None:
+            Logger.CURRENT.close()
+        Logger.CURRENT = Logger.DEFAULT
+        log("Reset logger")
+
+
+@contextlib.contextmanager
+def scoped_configure(dir: Optional[str] = None,
+                     format_strs: Optional[Sequence[str]] = None,
+                     comm: Any = None):
+    prevlogger = Logger.CURRENT
+    configure(dir=dir, format_strs=format_strs, comm=comm)
+    try:
+        yield
+    finally:
+        if Logger.CURRENT is not None:
+            Logger.CURRENT.close()
+        Logger.CURRENT = prevlogger

@@ -1,0 +1,12 @@
+"""Fixture worker for the launcher end-to-end test (run via --nprocs spawn)."""
+
+import argparse
+
+import distributed_pipeline_tpu.parallel as par
+
+ns = par.parse_and_autorun(argparse.ArgumentParser())
+par.setup_dist()
+import jax  # noqa: E402  (after setup_dist, like a real worker)
+
+assert jax.process_count() == 2, jax.process_count()
+print("RANK", jax.process_index(), "OK")

@@ -3,15 +3,26 @@
 This is the TPU-native answer to "multi-node without a cluster" (SURVEY.md §4):
 ``--xla_force_host_platform_device_count=8`` gives every test a real 8-device
 mesh to shard over, so DP/FSDP/TP/SP sharding is exercised without hardware.
-Must run before jax is imported anywhere.
+
+Two layers of forcing are required because this image's sitecustomize registers
+the remote-TPU ("axon") PJRT plugin in every interpreter AND overrides the
+platform selection via ``jax.config.update("jax_platforms", "axon,cpu")`` —
+which beats the JAX_PLATFORMS env var. Tests must never initialize that
+backend: the chip is single-tenant and a concurrent client wedges it.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+# Must happen before any backend initialization (overrides sitecustomize's
+# own config.update, which in turn overrides the env var).
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,130 @@
+"""Logger tests (SURVEY.md §4: sinks, CSV column migration, mean semantics)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from distributed_pipeline_tpu.utils import logger
+
+
+@pytest.fixture(autouse=True)
+def clean_logger():
+    yield
+    logger.reset()
+
+
+def test_logkv_overwrite_vs_mean(tmp_path):
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        logger.logkv("a", 1)
+        logger.logkv("a", 5)          # overwrite
+        logger.logkv_mean("b", 2)
+        logger.logkv_mean("b", 4)     # running mean
+        d = logger.dumpkvs()
+    assert d["a"] == 5
+    assert d["b"] == 3.0
+
+
+def test_dump_clears_accumulators(tmp_path):
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        logger.logkv("x", 1)
+        logger.dumpkvs()
+        assert logger.getkvs() == {}
+
+
+def test_json_sink(tmp_path):
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        logger.logkv("loss", 0.5)
+        logger.dumpkvs()
+        logger.logkv("loss", 0.25)
+        logger.dumpkvs()
+    lines = (tmp_path / "progress.json").read_text().strip().splitlines()
+    assert [json.loads(l)["loss"] for l in lines] == [0.5, 0.25]
+
+
+def test_csv_dynamic_column_migration(tmp_path):
+    # New keys appearing later must rewrite the header and pad old rows
+    # (reference logger.py:124-139).
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["csv"]):
+        logger.logkv("a", 1)
+        logger.dumpkvs()
+        logger.logkv("a", 2)
+        logger.logkv("b", 3)
+        logger.dumpkvs()
+    lines = (tmp_path / "progress.csv").read_text().strip().splitlines()
+    assert lines[0] == "a,b"
+    assert lines[1] == "1,"
+    assert lines[2] == "2,3"
+
+
+def test_human_sink_and_text_log(tmp_path):
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["log"]):
+        logger.info("hello", "world")
+        logger.logkv("metric", 1.234)
+        logger.dumpkvs()
+    txt = (tmp_path / "log.txt").read_text()
+    assert "hello world" in txt
+    assert "metric" in txt
+
+
+def test_level_gating(tmp_path):
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["log"]):
+        logger.set_level(logger.WARN)
+        logger.debug("nope")
+        logger.info("nope2")
+        logger.warn("yes")
+    txt = (tmp_path / "log.txt").read_text()
+    assert "nope" not in txt and "yes" in txt
+
+
+def test_profile_kv_accumulates(tmp_path):
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        with logger.profile_kv("sleepy"):
+            time.sleep(0.01)
+        with logger.profile_kv("sleepy"):
+            time.sleep(0.01)
+        d = logger.dumpkvs()
+    assert d["wait_sleepy"] >= 0.02
+
+
+def test_profile_decorator(tmp_path):
+    @logger.profile("fn")
+    def f():
+        return 42
+
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        assert f() == 42
+        assert "wait_fn" in logger.getkvs()
+
+
+def test_nonzero_rank_suffix_and_no_sink_write(tmp_path, monkeypatch):
+    # Non-zero ranks get -rank%03i suffixed files and skip sink writes
+    # (reference logger.py:373-377,463-465).
+    monkeypatch.setenv("JAX_PROCESS_INDEX", "2")
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["csv"]):
+        logger.logkv("a", 1)
+        d = logger.dumpkvs()
+    assert d == {"a": 1}  # still returned for callers
+    csv = tmp_path / "progress-rank002.csv"
+    assert csv.exists() and csv.read_text() == ""
+
+
+def test_scoped_configure_restores(tmp_path):
+    logger.configure(dir=str(tmp_path / "outer"), format_strs=["json"])
+    outer = logger.get_current()
+    with logger.scoped_configure(dir=str(tmp_path / "inner"), format_strs=["json"]):
+        assert logger.get_dir().endswith("inner")
+    assert logger.get_current() is outer
+
+
+def test_csv_resume_appends_consistently(tmp_path):
+    # Re-opening an existing CSV (checkpoint resume) must keep the header.
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["csv"]):
+        logger.logkv("a", 1)
+        logger.dumpkvs()
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["csv"]):
+        logger.logkv("a", 2)
+        logger.dumpkvs()
+    lines = (tmp_path / "progress.csv").read_text().strip().splitlines()
+    assert lines == ["a", "1", "2"]
