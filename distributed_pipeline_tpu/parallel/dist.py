@@ -177,8 +177,8 @@ def find_free_port() -> int:
     """(reference dist_util.py:155-159)"""
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:
-        s.bind(("", 0))
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
         return s.getsockname()[1]
     finally:
         s.close()

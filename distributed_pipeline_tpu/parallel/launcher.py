@@ -40,7 +40,10 @@ __all__ = [
 def create_distributed_parser() -> argparse.ArgumentParser:
     """Launcher-only args (mirror of reference dist_run.py:57-214, reshaped
     for the one-process-per-host JAX model)."""
-    p = argparse.ArgumentParser(add_help=False)
+    # allow_abbrev=False: parse_known_args must not steal prefix-abbreviated
+    # SCRIPT flags (e.g. a wrapped script's --proc would otherwise be consumed
+    # as --process_id).
+    p = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
     p.add_argument("--distributed", action="store_true",
                    help="launch/join a multi-process run")
     p.add_argument("--coordinator_address", default=None,
@@ -121,8 +124,37 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
             + f"--xla_force_host_platform_device_count={devices_per_proc}",
         })
         procs.append(subprocess.Popen(cmd_base, env=env))
-    codes = [p.wait() for p in procs]
-    return max(codes) if codes else 0
+    # Fail fast like torchrun's elastic agent: a worker that dies (e.g. on an
+    # import error before joining the ring) would leave its siblings blocked
+    # in jax.distributed.initialize forever — terminate them instead.
+    import time
+    codes: List[Optional[int]] = [None] * len(procs)
+    try:
+        while any(c is None for c in codes):
+            for i, p in enumerate(procs):
+                if codes[i] is None:
+                    codes[i] = p.poll()
+            failed = [i for i, c in enumerate(codes) if c not in (None, 0)]
+            if failed:
+                print(f"[launcher] worker(s) {failed} exited with "
+                      f"{[codes[i] for i in failed]}; terminating remaining workers")
+                for i, p in enumerate(procs):
+                    if codes[i] is None:
+                        p.terminate()
+                for i, p in enumerate(procs):
+                    if codes[i] is None:
+                        try:
+                            codes[i] = p.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            codes[i] = p.wait()
+                break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        raise
+    return max((c for c in codes if c is not None), default=0)
 
 
 def parse_and_autorun(
@@ -155,6 +187,13 @@ def parse_and_autorun(
         # dist.setup_dist, echo the command for the other hosts.
         if dist_ns.coordinator_address:
             os.environ["JAX_COORDINATOR_ADDRESS"] = dist_ns.coordinator_address
+        elif (dist_ns.num_processes and dist_ns.num_processes > 1
+              and "JAX_COORDINATOR_ADDRESS" not in os.environ):
+            # No address given: default to this host (assumed process 0) on a
+            # fixed port, so the echoed per-host command is actually runnable
+            # (torchrun's master_addr/port defaults, dist_run.py:198-213).
+            import socket
+            os.environ["JAX_COORDINATOR_ADDRESS"] = f"{socket.gethostname()}:12321"
         if dist_ns.num_processes:
             os.environ["JAX_NUM_PROCESSES"] = str(dist_ns.num_processes)
         if dist_ns.process_id is not None:
@@ -165,7 +204,7 @@ def parse_and_autorun(
             modname = get_main_modname() or "<module>"
             print(f"[launcher] per-host command (run with --process_id i): "
                   f"python -m {modname} --distributed "
-                  f"--coordinator_address {os.environ.get('JAX_COORDINATOR_ADDRESS')} "
+                  f"--coordinator_address {os.environ['JAX_COORDINATOR_ADDRESS']} "
                   f"--num_processes {dist_ns.num_processes} "
                   f"{' '.join(script_argv)}")
 

@@ -54,7 +54,7 @@ def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
     return tuple(sizes[a] for a in AXES)  # type: ignore[return-value]
 
 
-def make_mesh(dp: int = -1, fsdp: int = 1, tensor: int = 1, sequence: int = 1,
+def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the framework mesh. Works for 1 device (all axes size 1 except
     one) through multi-host pods; on real TPU slices

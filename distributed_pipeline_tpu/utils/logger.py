@@ -27,7 +27,6 @@ import datetime
 import json
 import os
 import os.path as osp
-import shutil
 import sys
 import tempfile
 import time
@@ -107,18 +106,20 @@ class HumanOutputFormat(KVWriter, SeqWriter):
         return s[:27] + "..." if len(s) > 30 else s
 
     def writekvs(self, kvs: Dict[str, Any]) -> None:
-        key2str = {}
+        # Rows, not a dict keyed by truncated names: keys that collide after
+        # truncation must both still be printed.
+        rows = []
         for key, val in sorted(kvs.items()):
             valstr = f"{val:<8.3g}" if hasattr(val, "__float__") else str(val)
-            key2str[self._truncate(key)] = self._truncate(valstr)
-        if not key2str:
+            rows.append((self._truncate(key), self._truncate(valstr)))
+        if not rows:
             warnings.warn("Tried to write empty key-value dict")
             return
-        keywidth = max(map(len, key2str.keys()))
-        valwidth = max(map(len, key2str.values()))
+        keywidth = max(len(k) for k, _ in rows)
+        valwidth = max(len(v) for _, v in rows)
         dashes = "-" * (keywidth + valwidth + 7)
         lines = [dashes]
-        for key, val in key2str.items():
+        for key, val in rows:
             lines.append(f"| {key}{' ' * (keywidth - len(key))} | "
                          f"{val}{' ' * (valwidth - len(val))} |")
         lines.append(dashes)
@@ -443,7 +444,8 @@ def distributed_mean_comm():
 
 
 def configure(dir: Optional[str] = None, format_strs: Optional[Sequence[str]] = None,
-              comm: Any = None, log_suffix: str = "") -> None:
+              comm: Any = None, log_suffix: str = "",
+              _close_prev: bool = True) -> None:
     """Configure the global logger (reference logger.py:448-477).
 
     Directory defaults to ``$OPENAI_LOGDIR`` or a dated tmp dir; non-zero
@@ -459,7 +461,7 @@ def configure(dir: Optional[str] = None, format_strs: Optional[Sequence[str]] = 
         )
     assert isinstance(dir, str)
     dir = osp.expanduser(dir)
-    os.makedirs(osp.expanduser(dir), exist_ok=True)
+    os.makedirs(dir, exist_ok=True)
 
     rank = _process_index()
     if rank > 0:
@@ -472,6 +474,11 @@ def configure(dir: Optional[str] = None, format_strs: Optional[Sequence[str]] = 
     format_strs = list(filter(None, format_strs))
     output_formats = [make_output_format(f, dir, log_suffix) for f in format_strs]
 
+    # Close the logger being replaced so its file handles flush and release
+    # (skipped by scoped_configure, which restores the previous logger).
+    if (_close_prev and Logger.CURRENT is not None
+            and Logger.CURRENT is not Logger.DEFAULT):
+        Logger.CURRENT.close()
     Logger.CURRENT = Logger(dir=dir, output_formats=output_formats, comm=comm)
     if output_formats:
         log(f"Logging to {dir}")
@@ -495,7 +502,7 @@ def scoped_configure(dir: Optional[str] = None,
                      format_strs: Optional[Sequence[str]] = None,
                      comm: Any = None):
     prevlogger = Logger.CURRENT
-    configure(dir=dir, format_strs=format_strs, comm=comm)
+    configure(dir=dir, format_strs=format_strs, comm=comm, _close_prev=False)
     try:
         yield
     finally:
