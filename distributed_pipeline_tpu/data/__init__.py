@@ -1,0 +1,184 @@
+"""Host-sharded infinite data pipeline.
+
+API parity with the reference loader (``/root/reference/data/__init__.py:1-38``):
+``load_data_from_args(split, data_dir, batch_size, deterministic, loop,
+num_loader_proc)`` returning an infinite iterator of batches, plus the
+``infinite_loader_from_iterable`` / ``infinite_loader_from_object`` helpers.
+
+TPU-native redesign instead of torch ``DataLoader``:
+
+* **Host sharding** — each JAX process draws a disjoint stride of the global
+  index stream (``process_index :: process_count``), matching the reference's
+  per-rank-loads-its-own-batch semantics (global batch = batch_size x hosts,
+  reference trainer.py:89) without any sampler object.
+* **Static shapes** — every batch is exactly ``[batch_size, seq_len]``; the
+  tail of an epoch wraps around rather than emitting a ragged batch, so the
+  jitted train step never recompiles.
+* **Background prefetch** — a bounded queue fed by worker threads overlaps
+  host-side batch assembly with device compute (the role of torch's
+  ``num_workers``/``persistent_workers``, reference data/__init__.py:17-23).
+  Threads, not processes: item synthesis is numpy-bound and the arrays go
+  straight to ``jax.device_put`` without pickling.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from typing import Any, Dict, Iterable, Iterator, Optional
+
+import numpy as np
+
+from .dataset import (
+    BOS_ID,
+    EOS_ID,
+    PAD_ID,
+    SEP_ID,
+    CustomDataset,
+    JsonlSeq2SeqDataset,
+    SyntheticLMDataset,
+    SyntheticSeq2SeqDataset,
+)
+
+__all__ = [
+    "load_data_from_args",
+    "infinite_loader_from_iterable",
+    "infinite_loader_from_object",
+    "batch_iterator",
+    "CustomDataset",
+    "JsonlSeq2SeqDataset",
+    "SyntheticLMDataset",
+    "SyntheticSeq2SeqDataset",
+]
+
+
+def infinite_loader_from_object(obj: Iterable) -> Iterator:
+    """Deepcopy-and-replay an exhaustible iterable forever (reference
+    data/__init__.py:30-33)."""
+    while True:
+        yield from copy.deepcopy(obj)
+
+
+def infinite_loader_from_iterable(it: Iterable) -> Iterator:
+    """``while True: yield from`` for restartable iterables (reference
+    data/__init__.py:36-38)."""
+    while True:
+        yield from it
+
+
+def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
+                       process_index: int, process_count: int,
+                       loop: bool) -> Iterator[int]:
+    """Yield this host's slice of the (optionally shuffled) global index
+    sequence; epochs reshuffle with a different fold of the seed."""
+    epoch = 0
+    while True:
+        if shuffle:
+            order = np.random.default_rng(
+                np.uint64(seed * 0x51ED2701 + epoch)).permutation(n_items)
+        else:
+            order = np.arange(n_items)
+        yield from order[process_index::process_count].tolist()
+        if not loop:
+            return
+        epoch += 1
+
+
+def batch_iterator(dataset: Any, batch_size: int, *, shuffle: bool = True,
+                   seed: int = 0, loop: bool = True,
+                   process_index: int = 0, process_count: int = 1,
+                   num_workers: int = 0,
+                   prefetch: int = 4) -> Iterator[Dict[str, np.ndarray]]:
+    """Assemble fixed-shape batches from any ``__len__``/``__getitem__``
+    dataset, host-sharded and optionally thread-prefetched."""
+    n = len(dataset)
+    if n < batch_size * process_count and not loop:
+        raise ValueError(
+            f"dataset of {n} items cannot fill one global batch of "
+            f"{batch_size}x{process_count} without looping")
+
+    def gen() -> Iterator[Dict[str, np.ndarray]]:
+        idx_stream = _host_index_stream(
+            n, shuffle=shuffle, seed=seed, process_index=process_index,
+            process_count=process_count, loop=loop)
+        while True:
+            items = []
+            for idx in idx_stream:
+                items.append(dataset[idx])
+                if len(items) == batch_size:
+                    break
+            if len(items) < batch_size:
+                return  # non-loop tail: drop ragged batch (static shapes)
+            yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    if num_workers <= 0:
+        return gen()
+    return _prefetched(gen, num_workers=num_workers, depth=prefetch)
+
+
+def _prefetched(gen_factory, *, num_workers: int, depth: int) -> Iterator:
+    """Run ``gen_factory()`` in a daemon thread feeding a bounded queue.
+
+    One producer thread suffices to hide batch-assembly latency behind device
+    compute (item synthesis is released-GIL numpy); ``num_workers`` scales the
+    queue depth the way torch's worker count scales its outstanding batches.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth * num_workers))
+    _END = object()
+
+    def worker() -> None:
+        try:
+            for batch in gen_factory():
+                q.put(batch)
+        finally:
+            q.put(_END)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        yield item
+
+
+def _build_dataset(dataset: str, data_dir: str, split: str, *, seq_len: int,
+                   vocab_size: int, seed: int) -> Any:
+    """Dataset registry: jsonl corpora when ``data_dir`` is given, synthetic
+    streams otherwise (the reference's TODO hook, data/__init__.py:13-14)."""
+    if data_dir:
+        return JsonlSeq2SeqDataset(data_dir, split, seq_len=seq_len,
+                                   vocab_size=vocab_size)
+    # Validation streams draw from a disjoint seed fold so eval is held out.
+    fold = seed if split == "train" else seed + 7919
+    if dataset in ("synthetic-lm", "lm", "gpt2"):
+        return SyntheticLMDataset(seq_len=seq_len, vocab_size=vocab_size,
+                                  seed=fold)
+    return SyntheticSeq2SeqDataset(seq_len=seq_len, vocab_size=vocab_size,
+                                   seed=fold)
+
+
+def load_data_from_args(split: str = "train", data_dir: str = "",
+                        batch_size: int = 1, deterministic: bool = False,
+                        loop: bool = True, num_loader_proc: int = 0,
+                        *, dataset: str = "synthetic-seq2seq",
+                        seq_len: int = 128, vocab_size: int = 8192,
+                        seed: int = 0, **_unused: Any) -> Iterator[Dict[str, np.ndarray]]:
+    """The reference's loader entry point (``data/__init__.py:1-27``), with
+    identical call semantics: ``deterministic`` disables shuffling (used for
+    the valid split, reference run/train.py:63), ``loop`` wraps the epoch
+    infinitely, ``num_loader_proc`` enables background prefetch. ``batch_size``
+    is per host; the global batch is ``batch_size * process_count``."""
+    import jax
+
+    ds = _build_dataset(dataset, data_dir, split, seq_len=seq_len,
+                        vocab_size=vocab_size, seed=seed)
+    return batch_iterator(
+        ds, batch_size,
+        shuffle=not deterministic,
+        seed=seed,
+        loop=loop,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        num_workers=num_loader_proc,
+    )

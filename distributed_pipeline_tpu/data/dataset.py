@@ -1,0 +1,216 @@
+"""Datasets for the seq2seq-diffusion and causal-LM workloads.
+
+The reference leaves its dataset as an all-stub ``CustomDataset``
+(``/root/reference/data/dataset.py:5-15``). This module fills the stub with
+concrete TPU-friendly datasets that share one batch contract:
+
+    batch = {
+        "input_ids":  int32 [B, L]   source ++ target token ids
+        "input_mask": int32 [B, L]   1 where the token belongs to the TARGET
+                                     (the diffused span for DiffuSeq; the
+                                     loss span for causal LM), 0 for source
+                                     and padding context
+        "pad_mask":   int32 [B, L]   1 for real tokens, 0 for padding
+    }
+
+All arrays are host-side numpy; the trainer moves them to device. Static
+shapes only — padding to ``seq_len`` keeps XLA from recompiling.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "SyntheticSeq2SeqDataset",
+    "SyntheticLMDataset",
+    "JsonlSeq2SeqDataset",
+    "WordVocab",
+    "CustomDataset",
+    "PAD_ID",
+    "BOS_ID",
+    "EOS_ID",
+    "SEP_ID",
+]
+
+# Reserved token ids shared by every dataset/vocab in the framework.
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+SEP_ID = 3
+N_RESERVED = 4
+
+
+class SyntheticSeq2SeqDataset:
+    """Deterministic synthetic seq2seq task: the target is the source sequence
+    reversed, with a fixed per-token offset. Learnable (so loss curves are
+    meaningful) yet needs no files — this powers the reference's
+    "single-process smoke test" config (BASELINE.md config 1).
+
+    Item i is generated from ``seed`` + i, so any host/worker can materialize
+    any index without coordination — the TPU-native answer to torch
+    DataLoader worker sharding.
+    """
+
+    def __init__(self, seq_len: int = 128, vocab_size: int = 8192,
+                 size: int = 100_000, seed: int = 0):
+        assert seq_len >= 8 and seq_len % 2 == 0, "seq_len must be even and >= 8"
+        assert vocab_size > N_RESERVED + 8
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.size = size
+        self.seed = seed
+        # src and tgt each get half the sequence (minus BOS/SEP/EOS framing).
+        self.src_len = seq_len // 2 - 1  # [BOS] src... [SEP]
+        self.tgt_len = seq_len - self.src_len - 3  # ... tgt [EOS]
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(np.uint64(self.seed * 0x9E3779B9 + idx))
+        n_src = int(rng.integers(self.src_len // 2, self.src_len + 1))
+        lo, hi = N_RESERVED, self.vocab_size
+        src = rng.integers(lo, hi, size=n_src, dtype=np.int64)
+        # Reversal + cyclic offset inside the payload id range.
+        tgt = ((src[::-1] - lo + 7) % (hi - lo)) + lo
+        n_tgt = min(len(tgt), self.tgt_len)
+        tgt = tgt[:n_tgt]
+
+        ids = np.full(self.seq_len, PAD_ID, dtype=np.int32)
+        tmask = np.zeros(self.seq_len, dtype=np.int32)
+        pmask = np.zeros(self.seq_len, dtype=np.int32)
+        pos = 0
+        ids[pos] = BOS_ID; pos += 1
+        ids[pos:pos + n_src] = src; pos += n_src
+        ids[pos] = SEP_ID; pos += 1
+        t0 = pos
+        ids[pos:pos + n_tgt] = tgt; pos += n_tgt
+        ids[pos] = EOS_ID; pos += 1
+        tmask[t0:pos] = 1  # target span includes EOS (model must learn to stop)
+        pmask[:pos] = 1
+        return {"input_ids": ids, "input_mask": tmask, "pad_mask": pmask}
+
+
+class SyntheticLMDataset:
+    """Synthetic causal-LM stream for the GPT-2 path (BASELINE.md config 4):
+    a tokenized pseudo-text with short-range structure (a noisy order-2 Markov
+    chain) so next-token loss is reducible below uniform."""
+
+    def __init__(self, seq_len: int = 128, vocab_size: int = 8192,
+                 size: int = 100_000, seed: int = 0):
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.size = size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(np.uint64(self.seed * 0x9E3779B9 + idx))
+        lo, hi = N_RESERVED, self.vocab_size
+        span = hi - lo
+        ids = np.empty(self.seq_len, dtype=np.int32)
+        ids[0] = BOS_ID
+        ids[1] = rng.integers(lo, hi)
+        for t in range(2, self.seq_len):
+            if rng.random() < 0.15:  # noise token
+                ids[t] = rng.integers(lo, hi)
+            else:  # deterministic order-2 successor
+                ids[t] = lo + (ids[t - 1] * 31 + ids[t - 2] * 17 + 11) % span
+        ones = np.ones(self.seq_len, dtype=np.int32)
+        return {"input_ids": ids,
+                "input_mask": ones.copy(),  # whole sequence is loss span
+                "pad_mask": ones}
+
+
+class WordVocab:
+    """Minimal whitespace-token vocabulary with stable hashing fallback.
+
+    Replaces the tokenizer the reference expects the user to bring
+    (``/root/reference/data/dataset.py`` TODO). A real run can drop in a
+    ``vocab.json`` (token -> id); absent that, tokens hash into the id space,
+    which is stable across hosts and runs (no Python hash randomization).
+    """
+
+    def __init__(self, vocab_size: int, vocab_file: Optional[str] = None):
+        self.vocab_size = vocab_size
+        self.token_to_id: Optional[Dict[str, int]] = None
+        if vocab_file and os.path.exists(vocab_file):
+            with open(vocab_file) as f:
+                self.token_to_id = json.load(f)
+
+    def encode(self, text: str) -> List[int]:
+        out = []
+        for tok in text.split():
+            if self.token_to_id is not None:
+                out.append(self.token_to_id.get(tok, N_RESERVED))
+            else:
+                h = int.from_bytes(
+                    hashlib.blake2s(tok.encode(), digest_size=8).digest(), "little")
+                out.append(N_RESERVED + h % (self.vocab_size - N_RESERVED))
+        return out
+
+
+class JsonlSeq2SeqDataset:
+    """DiffuSeq-format jsonl corpus: one ``{"src": ..., "trg": ...}`` object
+    per line in ``{split}.jsonl`` under ``data_dir``. Loaded fully into memory
+    (line offsets only), tokenized lazily per item."""
+
+    def __init__(self, data_dir: str, split: str, seq_len: int = 128,
+                 vocab_size: int = 8192, vocab_file: Optional[str] = None):
+        path = os.path.join(data_dir, f"{split}.jsonl")
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        with open(path) as f:
+            self.lines = [ln for ln in f if ln.strip()]
+        self.vocab = WordVocab(
+            vocab_size, vocab_file or os.path.join(data_dir, "vocab.json"))
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+
+    def __len__(self) -> int:
+        return len(self.lines)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        obj = json.loads(self.lines[idx])
+        src = self.vocab.encode(str(obj.get("src", "")))
+        tgt = self.vocab.encode(str(obj.get("trg", obj.get("tgt", ""))))
+        L = self.seq_len
+        # [BOS] src [SEP] tgt [EOS], truncating src from the left and tgt from
+        # the right so the freshest context survives.
+        max_src = max(1, (L - 3) // 2)
+        src = src[-max_src:]
+        max_tgt = L - 3 - len(src)
+        tgt = tgt[:max_tgt]
+        ids = np.full(L, PAD_ID, dtype=np.int32)
+        tmask = np.zeros(L, dtype=np.int32)
+        pmask = np.zeros(L, dtype=np.int32)
+        pos = 0
+        ids[pos] = BOS_ID; pos += 1
+        ids[pos:pos + len(src)] = src; pos += len(src)
+        ids[pos] = SEP_ID; pos += 1
+        t0 = pos
+        ids[pos:pos + len(tgt)] = tgt; pos += len(tgt)
+        ids[pos] = EOS_ID; pos += 1
+        tmask[t0:pos] = 1
+        pmask[:pos] = 1
+        return {"input_ids": ids, "input_mask": tmask, "pad_mask": pmask}
+
+
+class CustomDataset:
+    """Reference-API placeholder (``/root/reference/data/dataset.py:5-15``):
+    subclass and implement ``__len__``/``__getitem__`` returning the batch
+    contract above to plug any corpus into ``load_data_from_args``."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
