@@ -54,8 +54,8 @@ __all__ = [
 
 
 def infinite_loader_from_object(obj: Iterable) -> Iterator:
-    """Deepcopy-and-replay an exhaustible iterable forever (reference
-    data/__init__.py:30-33)."""
+    """Replay an exhaustible iterable forever by deep-copying it each epoch
+    and yielding its items (role of reference data/__init__.py:30-33)."""
     while True:
         yield from copy.deepcopy(obj)
 
@@ -72,14 +72,18 @@ def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
                        loop: bool) -> Iterator[int]:
     """Yield this host's slice of the (optionally shuffled) global index
     sequence; epochs reshuffle with a different fold of the seed."""
+    # Every host must yield the SAME number of items per epoch, or multi-host
+    # collectives desync (host 0's stride can be 1 longer): trim to the floor.
+    per_host = n_items // process_count
     epoch = 0
     while True:
         if shuffle:
             order = np.random.default_rng(
-                np.uint64(seed * 0x51ED2701 + epoch)).permutation(n_items)
+                (seed * 0x51ED2701 + epoch) & 0xFFFFFFFFFFFFFFFF
+            ).permutation(n_items)
         else:
             order = np.arange(n_items)
-        yield from order[process_index::process_count].tolist()
+        yield from order[process_index::process_count][:per_host].tolist()
         if not loop:
             return
         epoch += 1
@@ -126,20 +130,40 @@ def _prefetched(gen_factory, *, num_workers: int, depth: int) -> Iterator:
     """
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth * num_workers))
     _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded put that notices consumer shutdown, so an abandoned
+        # loop=True iterator doesn't leave the thread blocked forever
+        # holding a queue full of batches.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker() -> None:
         try:
             for batch in gen_factory():
-                q.put(batch)
-        finally:
-            q.put(_END)
+                if not _put(batch):
+                    return
+            _put(_END)
+        except BaseException as e:  # propagate to the consumer, don't die silent
+            _put(e)
 
     threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()  # reached on GeneratorExit/close as well as normal end
 
 
 def _build_dataset(dataset: str, data_dir: str, split: str, *, seq_len: int,
@@ -163,12 +187,15 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
                         loop: bool = True, num_loader_proc: int = 0,
                         *, dataset: str = "synthetic-seq2seq",
                         seq_len: int = 128, vocab_size: int = 8192,
-                        seed: int = 0, **_unused: Any) -> Iterator[Dict[str, np.ndarray]]:
+                        seed: int = 0, data_loader_workers: int = 0,
+                        **_unused: Any) -> Iterator[Dict[str, np.ndarray]]:
     """The reference's loader entry point (``data/__init__.py:1-27``), with
     identical call semantics: ``deterministic`` disables shuffling (used for
     the valid split, reference run/train.py:63), ``loop`` wraps the epoch
-    infinitely, ``num_loader_proc`` enables background prefetch. ``batch_size``
-    is per host; the global batch is ``batch_size * process_count``."""
+    infinitely, ``num_loader_proc`` enables background prefetch
+    (``data_loader_workers``, the ``DataSettings`` field name, is an accepted
+    alias so ``load_data_from_args(**settings.dict())`` wires prefetch).
+    ``batch_size`` is per host; global batch = ``batch_size * process_count``."""
     import jax
 
     ds = _build_dataset(dataset, data_dir, split, seq_len=seq_len,
@@ -180,5 +207,5 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
         loop=loop,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
-        num_workers=num_loader_proc,
+        num_workers=max(num_loader_proc, data_loader_workers),
     )

@@ -73,7 +73,8 @@ class SyntheticSeq2SeqDataset:
         return self.size
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
-        rng = np.random.default_rng(np.uint64(self.seed * 0x9E3779B9 + idx))
+        rng = np.random.default_rng(
+            (self.seed * 0x9E3779B9 + idx) & 0xFFFFFFFFFFFFFFFF)
         n_src = int(rng.integers(self.src_len // 2, self.src_len + 1))
         lo, hi = N_RESERVED, self.vocab_size
         src = rng.integers(lo, hi, size=n_src, dtype=np.int64)
@@ -113,17 +114,22 @@ class SyntheticLMDataset:
         return self.size
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
-        rng = np.random.default_rng(np.uint64(self.seed * 0x9E3779B9 + idx))
+        rng = np.random.default_rng(
+            (self.seed * 0x9E3779B9 + idx) & 0xFFFFFFFFFFFFFFFF)
         lo, hi = N_RESERVED, self.vocab_size
         span = hi - lo
+        # Pre-draw all randomness vectorized; the remaining Python loop is
+        # pure int arithmetic over the (inherently sequential) recurrence.
+        noisy = rng.random(self.seq_len) < 0.15
+        noise_tok = rng.integers(lo, hi, size=self.seq_len)
         ids = np.empty(self.seq_len, dtype=np.int32)
         ids[0] = BOS_ID
-        ids[1] = rng.integers(lo, hi)
+        ids[1] = noise_tok[1]
         for t in range(2, self.seq_len):
-            if rng.random() < 0.15:  # noise token
-                ids[t] = rng.integers(lo, hi)
+            if noisy[t]:
+                ids[t] = noise_tok[t]
             else:  # deterministic order-2 successor
-                ids[t] = lo + (ids[t - 1] * 31 + ids[t - 2] * 17 + 11) % span
+                ids[t] = lo + (int(ids[t - 1]) * 31 + int(ids[t - 2]) * 17 + 11) % span
         ones = np.ones(self.seq_len, dtype=np.int32)
         return {"input_ids": ids,
                 "input_mask": ones.copy(),  # whole sequence is loss span
@@ -160,8 +166,9 @@ class WordVocab:
 
 class JsonlSeq2SeqDataset:
     """DiffuSeq-format jsonl corpus: one ``{"src": ..., "trg": ...}`` object
-    per line in ``{split}.jsonl`` under ``data_dir``. Loaded fully into memory
-    (line offsets only), tokenized lazily per item."""
+    per line in ``{split}.jsonl`` under ``data_dir``. Raw lines are held in
+    memory (fine for corpora up to a few GB); parsing/tokenization happens
+    lazily per item."""
 
     def __init__(self, data_dir: str, split: str, seq_len: int = 128,
                  vocab_size: int = 8192, vocab_file: Optional[str] = None):

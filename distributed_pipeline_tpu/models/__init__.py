@@ -1,0 +1,169 @@
+"""Model factory and workload plug-in surface.
+
+Fills the reference's empty model layer (``/root/reference/models/__init__.py``
+is 0 bytes; ``utils/initialization.py:18-27`` ``create_model_from_config`` is a
+stub) with two concrete families behind the same factory call the reference
+entry point makes (``run/train.py:71`` passes ``**args.dict()``):
+
+* ``diffuseq`` — seq2seq embedding diffusion (base/large/xl presets);
+* ``gpt2``     — causal LM (base/medium/large/xl presets).
+
+The factory returns a :class:`Workload`: the flax module plus pure
+``init_params`` / ``compute_losses`` functions — the reference's user-hook
+trio (``compute_losses``/``backward_from_losses``/``log_loss_dict``,
+``utils/trainer.py:19-31``) collapsed into one functional object that the
+jitted trainer consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _random
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .backbone import TransformerBackbone
+from .diffuseq import DiffuSeqModel, diffuseq_losses
+from .diffusion import DiffusionSchedule, make_schedule
+from .gpt2 import GPT2Model, gpt2_losses
+
+__all__ = [
+    "Workload", "create_model_from_config", "seed_all", "PRESETS",
+    "DiffuSeqModel", "GPT2Model", "TransformerBackbone",
+    "make_schedule", "DiffusionSchedule",
+]
+
+# (hidden, layers, heads) per family/size.
+PRESETS: Dict[str, Dict[str, Tuple[int, int, int]]] = {
+    "diffuseq": {
+        "base": (768, 12, 12),    # BASELINE.md config 1/2
+        "large": (1024, 24, 16),  # config 3
+        "xl": (1600, 32, 25),     # config 5
+    },
+    "gpt2": {
+        "base": (768, 12, 12),
+        "medium": (1024, 24, 16),  # config 4
+        "large": (1280, 36, 20),
+        "xl": (1600, 48, 25),
+    },
+}
+DIFFUSEQ_EMB_DIM = 128  # DiffuSeq uses a low-dim embedding space
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """A model family bound to its pure loss function.
+
+    ``compute_losses(params, batch, rng) -> {"loss": scalar, ...metrics}`` is
+    jit-safe; the trainer differentiates it directly (the reference's separate
+    ``backward_from_losses`` hook disappears — grad is a transform, not a
+    method).
+    """
+
+    model: Any
+    family: str
+    seq_len: int
+    hidden_size: int
+    num_layers: int
+    compute_losses: Callable[[Any, Dict[str, jnp.ndarray], jax.Array],
+                             Dict[str, jnp.ndarray]]
+    example_batch: Callable[[int], Dict[str, np.ndarray]]
+    schedule: Optional[DiffusionSchedule] = None
+
+    def init_params(self, rng: jax.Array) -> Any:
+        """Initialize parameters from a dummy batch (shapes only)."""
+        batch = jax.tree_util.tree_map(jnp.asarray, self.example_batch(1))
+        if self.family == "diffuseq":
+            t = jnp.zeros((1,), jnp.int32)
+            return self.model.init(rng, batch["input_ids"], t,
+                                   batch["pad_mask"],
+                                   method=DiffuSeqModel.init_variables)
+        return self.model.init(rng, batch["input_ids"], batch["pad_mask"])
+
+    def param_count(self, params: Any) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def _example_batch_fn(seq_len: int) -> Callable[[int], Dict[str, np.ndarray]]:
+    def fn(batch_size: int) -> Dict[str, np.ndarray]:
+        ones = np.ones((batch_size, seq_len), np.int32)
+        ids = np.arange(batch_size * seq_len, dtype=np.int32).reshape(
+            batch_size, seq_len) % 7 + 4
+        mask = np.zeros_like(ones)
+        mask[:, seq_len // 2:] = 1
+        return {"input_ids": ids, "input_mask": mask, "pad_mask": ones}
+    return fn
+
+
+def create_model_from_config(*, model_family: str = "diffuseq",
+                             model_size: str = "base",
+                             vocab_size: int = 8192, seq_len: int = 128,
+                             hidden_size: int = 0, num_layers: int = 0,
+                             num_heads: int = 0,
+                             diffusion_steps: int = 2000,
+                             noise_schedule: str = "sqrt",
+                             dtype: str = "bfloat16", remat: bool = False,
+                             attention_impl: str = "auto",
+                             **_unused: Any) -> Workload:
+    """Build a :class:`Workload` from (a superset of) ``TrainSettings`` fields
+    — callable as ``create_model_from_config(**settings.dict())`` exactly like
+    the reference entry point (``run/train.py:71``). Preset dims can be
+    overridden individually via nonzero hidden/layers/heads."""
+    if model_family not in PRESETS:
+        raise ValueError(f"unknown model family: {model_family!r}; "
+                         f"available: {sorted(PRESETS)}")
+    preset = PRESETS[model_family].get(model_size)
+    if preset is None:
+        raise ValueError(f"no preset {model_size!r} for family {model_family!r}; "
+                         f"available: {sorted(PRESETS[model_family])}")
+    hidden = hidden_size or preset[0]
+    layers = num_layers or preset[1]
+    heads = num_heads or preset[2]
+    jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    if model_family == "diffuseq":
+        model = DiffuSeqModel(
+            vocab_size=vocab_size, seq_len=seq_len, hidden_size=hidden,
+            num_layers=layers, num_heads=heads, emb_dim=DIFFUSEQ_EMB_DIM,
+            dtype=jdtype, remat=remat, attention_impl=attention_impl)
+        schedule = make_schedule(noise_schedule, diffusion_steps)
+
+        def compute_losses(params, batch, rng):
+            return diffuseq_losses(model, schedule, params, batch, rng)
+
+        return Workload(model=model, family="diffuseq", seq_len=seq_len,
+                        hidden_size=hidden, num_layers=layers,
+                        compute_losses=compute_losses,
+                        example_batch=_example_batch_fn(seq_len),
+                        schedule=schedule)
+
+    else:  # "gpt2" — PRESETS membership was validated above
+        model = GPT2Model(
+            vocab_size=vocab_size, seq_len=seq_len, hidden_size=hidden,
+            num_layers=layers, num_heads=heads, dtype=jdtype, remat=remat,
+            attention_impl=attention_impl)
+
+        def compute_losses(params, batch, rng):
+            return gpt2_losses(model, params, batch, rng)
+
+        return Workload(model=model, family="gpt2", seq_len=seq_len,
+                        hidden_size=hidden, num_layers=layers,
+                        compute_losses=compute_losses,
+                        example_batch=_example_batch_fn(seq_len))
+
+
+def seed_all(seed: int, deterministic: bool = False) -> jax.Array:
+    """Global seeding with per-process offset (reference
+    ``utils/initialization.py:1-15``: non-deterministic mode offsets the seed
+    by rank so hosts draw different data/noise; deterministic mode keeps all
+    hosts identical). Returns the root JAX PRNG key — JAX's counter-based
+    PRNG replaces torch's stateful seeding."""
+    from ..parallel import dist
+
+    offset = 0 if deterministic else dist.get_rank()
+    _random.seed(seed + offset)
+    np.random.seed((seed + offset) % (2 ** 32))
+    return jax.random.PRNGKey(seed + offset)

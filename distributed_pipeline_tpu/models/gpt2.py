@@ -1,0 +1,74 @@
+"""GPT-2-style causal language model.
+
+The second workload family (BASELINE.md config 4): proves the framework's
+model/loss plug-in surface (``create_model_from_config`` +
+``compute_losses``) is model-agnostic, i.e. not welded to diffusion.
+Reference stub being filled: ``/root/reference/utils/initialization.py:18-27``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .backbone import EMBED, TransformerBackbone
+
+__all__ = ["GPT2Model", "gpt2_losses"]
+
+
+class GPT2Model(nn.Module):
+    """Decoder-only causal LM with weight-tied output head."""
+
+    vocab_size: int
+    seq_len: int
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray,
+                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        B, L = ids.shape
+        word_emb = nn.Embed(
+            self.vocab_size, self.hidden_size,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", EMBED)),
+            param_dtype=jnp.float32, name="word_emb")
+        pos_emb = self.param(
+            "pos_emb", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, EMBED)),
+            (self.seq_len, self.hidden_size), jnp.float32)
+        h = (word_emb(ids) + pos_emb[None, :L]).astype(self.dtype)
+        if pad_mask is None:
+            pad_mask = jnp.ones_like(ids)
+        h = TransformerBackbone(self.num_layers, self.num_heads, self.dtype,
+                                self.remat, causal=True,
+                                attention_impl=self.attention_impl,
+                                name="backbone")(h, pad_mask)
+        return word_emb.attend(h.astype(jnp.float32))  # [B, L, V] f32 logits
+
+
+def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
+                rng: jax.Array) -> Dict[str, jnp.ndarray]:
+    """Next-token cross-entropy over the loss span — the non-diffusion
+    ``compute_losses`` path (reference hook, utils/trainer.py:23-25).
+    ``rng`` is unused but kept for loss-fn signature uniformity."""
+    del rng
+    ids = batch["input_ids"]
+    pad_mask = batch["pad_mask"]
+    loss_mask = (batch["input_mask"] * pad_mask)[:, 1:].astype(jnp.float32)
+
+    logits = model.apply(params, ids, pad_mask)[:, :-1]  # predict ids[:, 1:]
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(loss_mask.sum(), 1.0)
+    loss = (nll * loss_mask).sum() / denom
+    return {"loss": loss, "nll": loss,
+            "ppl": jnp.exp(jnp.minimum(loss, 20.0))}

@@ -1,0 +1,90 @@
+"""Attention kernels: one entry point, multiple TPU implementations.
+
+The reference delegates all device kernels to cuDNN/cuBLAS through torch ops
+(SURVEY.md §2.1). The TPU-native equivalents live here behind a single
+dispatcher so models never hard-code a kernel choice:
+
+* ``impl="xla"``    — einsum softmax attention; XLA fuses it onto the MXU and
+                      is the strong baseline for seq_len <= ~1k.
+* ``impl="pallas"`` — FlashAttention-style blocked kernel written in Pallas
+                      (ops/flash_attention.py); O(L) memory, wins at long L.
+* ``impl="ring"``   — ring attention over the ``sequence`` mesh axis for
+                      context parallelism (parallel/ring.py); composes with
+                      blockwise attention per ring step.
+* ``impl="auto"``   — picks per platform/shape.
+
+The interface is structural — ``(q, k, v, pad_mask [B, L], causal)`` — not a
+dense additive bias: materializing a [B, 1, L, L] bias in HBM would defeat the
+O(L)-memory kernels. The XLA path expands the mask to a bias internally
+(cheap: it fuses). All impls take [B, H, L, Dh] tensors and are numerically
+interchangeable (tests assert pallas vs xla parity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dot_product_attention", "make_attention_bias", "causal_bias"]
+
+NEG_INF = -1e9  # large-negative in bf16-safe range; -inf would NaN the softmax
+# on fully-masked rows
+
+
+def causal_bias(L: int, dtype=jnp.float32) -> jnp.ndarray:
+    tri = jnp.tril(jnp.ones((L, L), dtype=bool))
+    return jnp.where(tri, 0.0, NEG_INF).astype(dtype)[None, None]
+
+
+def make_attention_bias(pad_mask: jnp.ndarray, causal: bool = False,
+                        dtype=jnp.float32) -> jnp.ndarray:
+    """Expand a [B, L] validity mask (optionally + causal triangle) into an
+    additive [B, 1, Lq, Lk] bias — used by the XLA path only."""
+    b = (1 - pad_mask[:, None, None, :]).astype(dtype) * NEG_INF
+    if causal:
+        b = b + causal_bias(pad_mask.shape[-1], dtype)
+    return b
+
+
+def _xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   pad_mask: Optional[jnp.ndarray],
+                   causal: bool) -> jnp.ndarray:
+    """Reference einsum attention. Softmax statistics in f32 regardless of
+    activation dtype (bf16 logits lose too much for long rows)."""
+    dh = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * (dh ** -0.5)
+    if pad_mask is not None:
+        logits = logits + make_attention_bias(pad_mask, causal, logits.dtype)
+    elif causal:
+        logits = logits + causal_bias(q.shape[-2], logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          pad_mask: Optional[jnp.ndarray] = None,
+                          causal: bool = False,
+                          impl: str = "auto") -> jnp.ndarray:
+    """Multi-head attention on [B, H, L, Dh] tensors.
+
+    ``pad_mask`` is [B, L] (1 = real token); ``impl`` selects the kernel
+    (module docstring); "auto" uses the pallas flash kernel on TPU for long
+    sequences and XLA einsum otherwise.
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "pallas" if (on_tpu and q.shape[-2] >= 512) else "xla"
+    if impl == "xla":
+        return _xla_attention(q, k, v, pad_mask, causal)
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, pad_mask, causal)
+    if impl == "ring":
+        raise ValueError(
+            "ring attention is mesh-scoped; call parallel.ring.ring_attention "
+            "inside shard_map rather than through this dispatcher")
+    raise ValueError(f"unknown attention impl: {impl}")

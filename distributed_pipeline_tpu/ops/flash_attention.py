@@ -1,0 +1,191 @@
+"""FlashAttention forward kernel in Pallas for TPU.
+
+Blocked online-softmax attention: for each query block the kernel streams key/
+value blocks through VMEM, keeping running max/normalizer/accumulator scratch,
+so the [L, L] score matrix never exists in HBM — O(L) memory instead of the
+XLA path's O(L^2) logits. This is the framework's long-context forward kernel
+(the reference has no native kernels at all, SURVEY.md §2.1; its GPU
+equivalent would be a fused cuDNN/triton attention).
+
+Layout choices per the TPU tiling rules (/opt/skills/guides/pallas_guide.md):
+last dim padded to a multiple of 128 lanes, running softmax stats kept as
+[block_q, 128] replicated tiles, scores accumulated in f32 on the MXU via
+``preferred_element_type``.
+
+Gradients: ``jax.custom_vjp`` with a recompute backward through the XLA path
+(correct everywhere; a blocked Pallas backward is a planned optimization —
+training at the BASELINE.md sequence lengths is MXU-bound, not HBM-bound, so
+forward is where flash pays off first).
+
+On non-TPU backends the kernel runs in Pallas interpreter mode, so CPU tests
+exercise the real kernel logic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific bits are unavailable in some CPU-only wheels
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention"]
+
+NEG_INF = -1e9
+LANES = 128  # TPU lane width: last-dim tiles and stat buffers align to this
+
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
+                acc_ref, m_ref, l_ref, *,
+                sm_scale: float, causal: bool,
+                block_q: int, block_k: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: whole k-block strictly in the future of the whole q-block
+    # contributes nothing — skip its compute entirely.
+    block_live = True
+    if causal:
+        block_live = ik * block_k < (iq + 1) * block_q
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0]                       # [block_q, D]
+        k = k_ref[0]                       # [block_k, D]
+        v = v_ref[0]                       # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        kmask = mask_ref[0]                # [block_k] (1 = real token)
+        s = s + (1.0 - kmask.astype(jnp.float32))[None, :] * NEG_INF
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                             # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        # Fully-masked query rows have l == 0; emit zeros, not NaNs.
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   pad_mask: Optional[jnp.ndarray], causal: bool,
+                   block_q: int, block_k: int) -> jnp.ndarray:
+    B, H, L, Dh = q.shape
+    sm_scale = Dh ** -0.5  # scale by the REAL head dim; zero-padding Dh
+    # leaves q·k unchanged
+
+    if pad_mask is None:
+        pad_mask = jnp.ones((B, L), jnp.int32)
+    block_q = min(block_q, max(L, 8))
+    block_k = min(block_k, max(L, 8))
+
+    qp = _pad_to(_pad_to(q, 3, LANES), 2, block_q)
+    kp = _pad_to(_pad_to(k, 3, LANES), 2, block_k)
+    vp = _pad_to(_pad_to(v, 3, LANES), 2, block_k)
+    maskp = _pad_to(pad_mask, 1, max(block_q, block_k))  # padded keys -> 0
+    Lq, Lk, D = qp.shape[2], kp.shape[2], qp.shape[3]
+
+    bh = B * H
+    qp = qp.reshape(bh, Lq, D)
+    kp = kp.reshape(bh, Lk, D)
+    vp = vp.reshape(bh, Lk, D)
+    grid = (bh, Lq // block_q, Lk // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_k),                      # key-side pad mask
+                         lambda b, i, j: (b // H, j),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                         memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                               memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, Lq, D), q.dtype),
+        scratch_shapes=[
+            _VMEM((block_q, D), jnp.float32),       # acc
+            _VMEM((block_q, LANES), jnp.float32),   # running max (replicated)
+            _VMEM((block_q, LANES), jnp.float32),   # running normalizer
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(maskp, qp, kp, vp)
+    return out.reshape(B, H, Lq, D)[:, :, :L, :Dh]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    pad_mask: Optional[jnp.ndarray] = None,
+                    causal: bool = False,
+                    block_q: int = 128, block_k: int = 128) -> jnp.ndarray:
+    """Blocked O(L)-memory attention on [B, H, L, Dh]; numerically matches
+    ops.attention._xla_attention (see tests/test_ops.py)."""
+    return _flash_forward(q, k, v, pad_mask, causal, block_q, block_k)
+
+
+def _fwd(q, k, v, pad_mask, causal, block_q, block_k):
+    return _flash_forward(q, k, v, pad_mask, causal, block_q, block_k), \
+        (q, k, v, pad_mask)
+
+
+def _bwd(causal, block_q, block_k, res, g):
+    # Recompute backward via the XLA path: exact same math, O(L^2) scores
+    # rematerialized only inside the fused backward.
+    from .attention import _xla_attention
+    q, k, v, pad_mask = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, pad_mask,
+                                                       causal), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_fwd, _bwd)
