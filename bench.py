@@ -1,0 +1,83 @@
+"""Benchmark: DiffuSeq-base training throughput on the available hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+
+The headline config is BASELINE.md's north star (DiffuSeq-base, seq_len=128,
+bf16). The reference publishes no absolute numbers (BASELINE.md), so
+``vs_baseline`` reports achieved MFU / the 40% MFU target from
+/root/repo/BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.parallel import make_mesh
+    from distributed_pipeline_tpu.utils.perf import (
+        mfu,
+        transformer_train_flops_per_token,
+    )
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    on_tpu = jax.default_backend() == "tpu"
+    seq_len = 128
+    # Per-chip batch sized for one v4 chip's HBM at base scale; tiny on CPU
+    # so the smoke run finishes quickly.
+    batch = 64 * jax.device_count() if on_tpu else 8
+    steps = 30 if on_tpu else 3
+    wl = create_model_from_config(
+        model_family="diffuseq", model_size="base", vocab_size=8192,
+        seq_len=seq_len, dtype="bfloat16" if on_tpu else "float32")
+
+    def batches():
+        import numpy as np
+        rng = np.random.default_rng(0)
+        while True:
+            ids = rng.integers(4, 8192, (batch, seq_len)).astype(np.int32)
+            mask = np.zeros((batch, seq_len), np.int32)
+            mask[:, seq_len // 2:] = 1
+            yield {"input_ids": ids, "input_mask": mask,
+                   "pad_mask": np.ones((batch, seq_len), np.int32)}
+
+    loop = TrainLoop(model=wl, data=batches(), batch_size=batch,
+                     microbatch=batch, lr=1e-4, ema_rate="0.9999",
+                     learning_steps=0, log_interval=10 ** 9,
+                     save_interval=10 ** 9, mesh=make_mesh(dp=-1),
+                     checkpoint_dir="", seed=0)
+
+    # warmup (compile) then timed window
+    m = loop.run_step(next(loop.data))
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = loop.run_step(next(loop.data))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq_len / dt
+    per_chip = tokens_per_sec / jax.device_count()
+    fpt = transformer_train_flops_per_token(
+        loop.n_params, wl.num_layers, wl.hidden_size, seq_len)
+    achieved_mfu = mfu(tokens_per_sec, fpt)
+    print(json.dumps({
+        "metric": "tokens/sec/chip (DiffuSeq-base seq128 train, "
+                  f"{jax.devices()[0].device_kind})",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(achieved_mfu / 0.40, 4),
+        "mfu": round(achieved_mfu, 4),
+        "n_params": loop.n_params,
+        "n_devices": jax.device_count(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,10 @@ def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
     # Every host must yield the SAME number of items per epoch, or multi-host
     # collectives desync (host 0's stride can be 1 longer): trim to the floor.
     per_host = n_items // process_count
+    if per_host == 0:
+        raise ValueError(
+            f"dataset of {n_items} items cannot feed {process_count} hosts "
+            f"(at least one item per host per epoch required)")
     epoch = 0
     while True:
         if shuffle:

@@ -71,7 +71,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        kmask = mask_ref[0]                # [block_k] (1 = real token)
+        kmask = mask_ref[0, 0]             # [block_k] (1 = real token)
         s = s + (1.0 - kmask.astype(jnp.float32))[None, :] * NEG_INF
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
@@ -123,8 +123,12 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qp = _pad_to(_pad_to(q, 3, LANES), 2, block_q)
     kp = _pad_to(_pad_to(k, 3, LANES), 2, block_k)
     vp = _pad_to(_pad_to(v, 3, LANES), 2, block_k)
-    maskp = _pad_to(pad_mask, 1, max(block_q, block_k))  # padded keys -> 0
+    # Key-side mask padded to exactly Lk (padded keys -> 0), then given an
+    # 8-row sublane dim: a (1, block_k) mask block would violate the TPU
+    # (8, 128) tile floor for any B > 1.
+    maskp = _pad_to(pad_mask, 1, block_k)
     Lq, Lk, D = qp.shape[2], kp.shape[2], qp.shape[3]
+    mask8 = jnp.broadcast_to(maskp[:, None, :], (B, 8, Lk))
 
     bh = B * H
     qp = qp.reshape(bh, Lq, D)
@@ -139,8 +143,8 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_k),                      # key-side pad mask
-                         lambda b, i, j: (b // H, j),
+            pl.BlockSpec((1, 8, block_k),                   # key-side pad mask
+                         lambda b, i, j: (b // H, 0, j),
                          memory_space=_VMEM),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                          memory_space=_VMEM),
@@ -158,7 +162,7 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             _VMEM((block_q, LANES), jnp.float32),   # running normalizer
         ],
         interpret=jax.default_backend() != "tpu",
-    )(maskp, qp, kp, vp)
+    )(mask8, qp, kp, vp)
     return out.reshape(B, H, Lq, D)[:, :, :L, :Dh]
 
 

@@ -1,0 +1,99 @@
+"""Sharding rules: logical axis names -> mesh axes.
+
+This file is the whole "parallelism engine" — the TPU-native replacement for
+the reference's DDP wrapper (``/root/reference/utils/trainer.py:115-128``) and
+the hook its `grad_clip` leaves for sharded optimizers (``trainer.py:246-255``).
+Models annotate weights with logical names (models/backbone.py); this module
+maps them onto the mesh; XLA inserts every collective. Changing parallelism
+strategy (DP -> FSDP -> +TP) is a rules/mesh change, zero engine code
+(SURVEY.md §2.2, BASELINE.md configs 2/3/5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import batch_spec
+
+__all__ = ["LOGICAL_RULES", "param_shardings", "batch_shardings",
+           "shard_batch", "replicated"]
+
+# Logical-name -> mesh-axis rules.
+#   embed  -> fsdp:   parameter/optimizer sharding (ZeRO-3 analogue): every
+#                     weight has an "embed" dim, so every weight shards.
+#   mlp/heads -> tensor: Megatron-style TP pairing — wi column-, wo
+#                     row-parallel; attention heads split across chips.
+#   vocab  -> tensor: embedding/logit matrix splits over vocab.
+LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("vocab", "tensor"),
+    ("embed", "fsdp"),
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("length", "sequence"),
+)
+
+
+def param_shardings(mesh: Mesh, abstract_variables: Any,
+                    rules: Sequence[Tuple[str, Any]] = LOGICAL_RULES) -> Any:
+    """NamedShardings for a (possibly abstract) boxed variables tree carrying
+    flax logical-partitioning metadata. Axes whose size the dim doesn't divide
+    fall back to replication (so tiny test models shard cleanly)."""
+    specs = nn.get_partition_spec(abstract_variables)
+    shapes = jax.tree_util.tree_map(lambda x: x.shape,
+                                    nn.meta.unbox(abstract_variables))
+
+    def fix(spec: P, shape) -> NamedSharding:
+        fixed = []
+        for dim, ax in zip(shape, tuple(spec) + (None,) * len(shape)):
+            axes = ax if isinstance(ax, tuple) else (ax,) if ax else ()
+            size = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+            fixed.append(ax if size > 1 and dim % size == 0 else None)
+        return NamedSharding(mesh, P(*fixed))
+
+    mesh_specs = nn.logical_to_mesh_sharding(specs, mesh, list(rules))
+    return jax.tree_util.tree_map(
+        lambda s, shape: fix(s.spec, shape), mesh_specs, shapes)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_shardings(mesh: Mesh, microbatched: bool = False,
+                    seq_sharded: bool = False) -> NamedSharding:
+    """Sharding for data batches: [B, ...] over (data, fsdp) — FSDP ranks
+    consume distinct data shards, ZeRO semantics. ``microbatched`` prepends an
+    unsharded gradient-accumulation axis [n_micro, B_micro, ...]."""
+    spec = batch_spec(mesh, seq_sharded=seq_sharded)
+    if microbatched:
+        spec = P(None, *spec)
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh: Mesh, batch: Dict[str, np.ndarray],
+                sharding: Optional[NamedSharding] = None,
+                batch_axis: int = 0) -> Dict[str, jax.Array]:
+    """Host-local numpy batch -> global device array. Single-host this is a
+    sharded device_put; multi-host it assembles the global array from each
+    process's local shard (the reference's per-rank-batch semantics,
+    trainer.py:89: global batch = local x world_size). ``batch_axis`` is 1
+    for microbatched [n_micro, B_micro, ...] arrays."""
+    if sharding is None:
+        sharding = batch_shardings(mesh, microbatched=batch_axis == 1)
+
+    def put(x: np.ndarray) -> jax.Array:
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        global_shape = list(x.shape)
+        global_shape[batch_axis] *= jax.process_count()
+        return jax.make_array_from_process_local_data(
+            sharding, x, tuple(global_shape))
+
+    return {k: put(v) for k, v in batch.items()}

@@ -1,0 +1,116 @@
+"""Training entry point.
+
+Parity with the reference entry (``/root/reference/run/train.py:5-126``):
+config -> distributed setup -> run dir -> logger -> seeding -> data ->
+model -> args snapshot -> optional wandb -> TrainLoop. Launchable three ways,
+exactly like the reference CLI (``run/train.py:124-126`` + ``train.sh``):
+
+    python -m distributed_pipeline_tpu.run.train --config_json train_config.json
+    python -m distributed_pipeline_tpu.run.train --lr 1e-4 --model_family gpt2 ...
+    python -m distributed_pipeline_tpu.run.train --distributed [--nprocs N] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+from ..config.train import TrainSettings
+
+
+def create_parser() -> argparse.ArgumentParser:
+    """(reference run/train.py:5-6)"""
+    return TrainSettings.to_argparse(add_json=True)
+
+
+def main(namespace: argparse.Namespace) -> None:
+    """(reference run/train.py:10-121; late imports keep ``--help`` fast,
+    mirroring the reference's in-function imports at train.py:15-24)"""
+    args = TrainSettings.from_argparse(namespace)
+
+    import jax
+
+    from .. import parallel
+    from ..data import load_data_from_args
+    from ..models import create_model_from_config, seed_all
+    from ..parallel import dist, make_mesh
+    from ..parallel.mesh import local_mesh_info
+    from ..utils import logger
+    from ..utils.trainer import TrainLoop
+
+    dist.setup_dist()
+    rank = dist.get_rank()
+
+    # Run dir: model_checkpoints/Run_{dataset}_lr{lr}_seed{seed}_{ts}
+    # (reference train.py:32-40), created by process 0.
+    ckpt_path = args.checkpoint_path
+    if not ckpt_path:
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        ckpt_path = os.path.join(
+            "model_checkpoints",
+            f"Run_{args.dataset}_lr{args.lr}_seed{args.seed}_{ts}")
+    if rank == 0:
+        os.makedirs(ckpt_path, exist_ok=True)
+    dist.barrier("mkdir")
+
+    # log+csv sinks everywhere, stdout on the writer rank
+    # (reference train.py:43).
+    logger.configure(dir=ckpt_path,
+                     format_strs=["log", "csv"] + (["stdout"] if rank == 0
+                                                   else []))
+    seed_all(args.seed)
+
+    data = load_data_from_args("train", **args.dict())
+    eval_data = load_data_from_args(
+        "valid", **{**args.dict(), "deterministic": True})
+
+    workload = create_model_from_config(**args.dict())
+    mesh = make_mesh(dp=args.dp, fsdp=args.fsdp, sequence=args.sequence,
+                     tensor=args.tensor)
+    logger.info(local_mesh_info(mesh))
+
+    if rank == 0:  # args snapshot for reproducibility (train.py:82-87)
+        with open(os.path.join(ckpt_path, "training_args.json"), "w") as f:
+            f.write(args.to_json())
+    if rank == 0 and os.environ.get("WANDB_MODE", "disabled") != "disabled":
+        try:  # optional, rank-0 only (reference train.py:90-98)
+            import wandb
+            wandb.init(project=os.environ.get("WANDB_PROJECT", "dpt"),
+                       mode=os.environ["WANDB_MODE"])
+            wandb.config.update(json.loads(args.to_json()),
+                                allow_val_change=True)
+        except Exception as e:
+            logger.warn(f"wandb unavailable: {e}")
+
+    loop = TrainLoop(
+        model=workload,
+        data=data,
+        eval_data=eval_data,
+        batch_size=args.batch_size,
+        microbatch=args.microbatch,
+        lr=args.lr,
+        ema_rate=args.ema_rate,
+        log_interval=args.log_interval,
+        eval_interval=args.eval_interval,
+        save_interval=args.save_interval,
+        resume_checkpoint=args.resume_checkpoint,
+        gradient_clipping=args.gradient_clipping,
+        weight_decay=args.weight_decay,
+        learning_steps=args.learning_steps,
+        mesh=mesh,
+        checkpoint_dir=ckpt_path,
+        seed=args.seed,
+    )
+    n_m = loop.n_params / 1e6
+    logger.info(f"the parameter count is {loop.n_params} ({n_m:.1f}M)")
+    loop.run_loop()
+
+
+if __name__ == "__main__":
+    from ..parallel.launcher import parse_and_autorun
+
+    ns = parse_and_autorun(create_parser())
+    if ns is not None:
+        main(ns)

@@ -1,1 +1,5 @@
 from . import logger
+
+# trainer/checkpoint/perf are imported lazily by consumers: pulling them in
+# here would make every logger-only import (e.g. the launcher) pay the full
+# jax/flax/optax import cost.

@@ -278,7 +278,7 @@ def dumpkvs() -> Dict[str, Any]:
 
 
 def getkvs() -> Dict[str, Any]:
-    return get_current().name2val
+    return get_current().merged_kvs()
 
 
 def log(*args: Any, level: int = INFO) -> None:
@@ -350,7 +350,7 @@ class Logger:
     def __init__(self, dir: Optional[str], output_formats: Sequence[KVWriter],
                  comm: Any = None):
         self.name2val: Dict[str, float] = defaultdict(float)
-        self.name2cnt: Dict[str, int] = defaultdict(int)
+        self.name2mean: Dict[str, list] = {}
         self.level = INFO
         self.dir = dir
         self.output_formats = list(output_formats)
@@ -361,14 +361,25 @@ class Logger:
         self.name2val[key] = val
 
     def logkv_mean(self, key: str, val: Any) -> None:
-        oldval, cnt = self.name2val[key], self.name2cnt[key]
-        self.name2val[key] = oldval * cnt / (cnt + 1) + float(val) / (cnt + 1)
-        self.name2cnt[key] = cnt + 1
+        # Values are buffered raw and averaged at dumpkvs: no float(val) here,
+        # or every logged jax device scalar forces a device->host sync per
+        # step (the reference's grad-norm bug, trainer.py:265-271). Buffering
+        # also never does array arithmetic, so values from different device
+        # meshes can coexist until they become floats at dump.
+        self.name2mean.setdefault(key, []).append(val)
+
+    def merged_kvs(self) -> Dict[str, Any]:
+        """Overwrite-keys plus materialized means (device scalars become
+        floats here — the single sync point)."""
+        d = dict(self.name2val)
+        for key, buf in self.name2mean.items():
+            d[key] = sum(float(v) for v in buf) / len(buf)
+        return d
 
     def dumpkvs(self) -> Dict[str, Any]:
         if self.level == DISABLED:
             return {}
-        d = dict(self.name2val)
+        d = self.merged_kvs()
         if self.comm is not None:
             d = self.comm(d)
         if _process_index() == 0:
@@ -376,7 +387,7 @@ class Logger:
                 if isinstance(fmt, KVWriter):
                     fmt.writekvs(d)
         self.name2val.clear()
-        self.name2cnt.clear()
+        self.name2mean.clear()
         return d
 
     # text API

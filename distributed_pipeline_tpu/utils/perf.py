@@ -1,0 +1,84 @@
+"""Performance accounting: step timing, tokens/sec, and MFU.
+
+The reference's only profiling is the logger's wall-time context manager
+(``/root/reference/basic_utils/logger.py:296-320``) plus a grad-norm metric
+that forces a device->host sync every step (``utils/trainer.py:265-271``).
+Here the north-star metric (BASELINE.md: tokens/sec/chip + MFU) gets
+first-class gauges, and nothing in the hot path blocks on the device.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+__all__ = ["device_peak_flops", "transformer_train_flops_per_token",
+           "StepTimer", "mfu"]
+
+# Peak dense bf16 FLOP/s per chip (public spec sheets). CPU entry keeps the
+# gauge meaningful in tests.
+_PEAK_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12, "v5e": 197e12,
+    "v5p": 459e12, "v6e": 918e12, "v6p": 4614e12 / 2,  # v6p per-chip bf16
+    "cpu": 1e11,
+}
+
+
+def device_peak_flops(device: Optional[jax.Device] = None) -> float:
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for key, flops in _PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    if d.platform == "tpu":  # unknown TPU generation: assume v4-class
+        return _PEAK_FLOPS["v4"]
+    return _PEAK_FLOPS["cpu"]
+
+
+def transformer_train_flops_per_token(n_params: int, n_layers: int,
+                                      hidden: int, seq_len: int) -> float:
+    """fwd+bwd FLOPs per trained token: the 6N weight-matmul term plus the
+    12*l*h*s attention term (score + value matmuls, forward 4lhs, x3 with
+    backward) — the standard accounting (e.g. PaLM appendix B)."""
+    return 6.0 * n_params + 12.0 * n_layers * hidden * seq_len
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        n_devices: Optional[int] = None) -> float:
+    n = n_devices if n_devices is not None else jax.device_count()
+    return tokens_per_sec * flops_per_token / (device_peak_flops() * n)
+
+
+class StepTimer:
+    """Wall-clock step timing with warmup skip (first steps compile).
+
+    ``lap()`` returns (steps/sec, tokens/sec) over the window since the last
+    call. Async-dispatch friendly: call it right after a ``block_until_ready``
+    on the step output (or accept one-step skew).
+    """
+
+    def __init__(self, tokens_per_step: float, warmup: int = 2):
+        self.tokens_per_step = tokens_per_step
+        self.warmup = warmup
+        self._steps = 0
+        self._t0: Optional[float] = None
+        self._window_steps = 0
+
+    def tick(self) -> None:
+        self._steps += 1
+        if self._steps == self.warmup:
+            self._t0 = time.perf_counter()
+            self._window_steps = 0
+        elif self._steps > self.warmup:
+            self._window_steps += 1
+
+    def lap(self):
+        if self._t0 is None or self._window_steps == 0:
+            return 0.0, 0.0
+        dt = time.perf_counter() - self._t0
+        sps = self._window_steps / max(dt, 1e-9)
+        self._t0 = time.perf_counter()
+        self._window_steps = 0
+        return sps, sps * self.tokens_per_step

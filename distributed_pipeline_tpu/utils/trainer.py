@@ -1,0 +1,395 @@
+"""TrainLoop: the training runtime as ONE jitted step.
+
+Capability parity with the reference engine (``/root/reference/utils/
+trainer.py``): microbatch gradient accumulation, AdamW, multi-rate EMA,
+linear LR annealing, gradient clipping with grad-norm telemetry, interval-
+driven log/eval/save, and filename-convention checkpoint/resume.
+
+TPU-native redesign (SURVEY.md §3.4 hot-loop notes) — everything the
+reference does eagerly folds into a single compiled step:
+
+==============================================  ===========================
+reference (eager torch, per step)               here (inside one jit)
+==============================================  ===========================
+python micro loop + DDP ``no_sync`` juggling    ``lax.scan`` over a
+  (trainer.py:230-235, 216-220)                 [n_micro, ...] batch; XLA
+                                                emits ONE gradient psum
+``(p.grad**2).sum().item()`` per param — a      ``optax.global_norm`` as a
+  device->host sync every step (:265-271)       device scalar, no sync
+``_anneal_lr`` mutating opt groups (:257)       optax schedule traced into
+                                                the step
+EMA python loop per rate (:360-370)             vectorized pytree lerp
+DDP bucketed all-reduce (:115-128)              sharding propagation: grads
+                                                inherit the params' specs
+==============================================  ===========================
+
+The loop structure, hook names, and checkpoint layout stay recognizably the
+reference's (``run_loop``/``run_step``/``forward_only``/``save``), so a user
+of the reference scaffold finds the same control surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax import struct
+from jax.sharding import Mesh
+
+from ..models import Workload
+from ..parallel import mesh as mesh_lib
+from ..parallel.sharding import batch_shardings, param_shardings, shard_batch
+from . import checkpoint as ckpt_lib
+from . import logger
+from .perf import StepTimer, device_peak_flops, mfu, \
+    transformer_train_flops_per_token
+
+__all__ = ["TrainLoop", "TrainState", "update_ema"]
+
+
+@struct.dataclass
+class TrainState:
+    """Everything the jitted step owns (donated and returned every step)."""
+
+    step: jnp.ndarray            # int32 scalar
+    params: Any
+    opt_state: Any
+    ema: Dict[str, Any]          # rate-string -> params-shaped tree
+
+
+def update_ema(ema: Any, params: Any, rate: float) -> Any:
+    """``trg = trg*rate + src*(1-rate)`` as a pytree lerp (reference
+    ``update_ema``, trainer.py:360-370, in-place loop)."""
+    return jax.tree_util.tree_map(
+        lambda e, p: e * rate + p * (1.0 - rate), ema, params)
+
+
+def _abstract_like(tree: Any) -> Any:
+    """Live tree -> ShapeDtypeStructs carrying the live shardings (the
+    restore target for checkpoint resume)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+        tree)
+
+
+class TrainLoop:
+    """Reference-shaped constructor (``TrainLoop(...)`` then ``.run_loop()``
+    or ``()``, trainer.py:45/175/357); ``model`` is a :class:`models.Workload`.
+    """
+
+    def __init__(
+        self,
+        *,
+        model: Workload,
+        data: Iterator[Dict[str, np.ndarray]],
+        batch_size: int,
+        microbatch: int = -1,
+        lr: float = 1e-4,
+        ema_rate: str = "0.9999",
+        log_interval: int = 50,
+        eval_interval: int = 1000,
+        save_interval: int = 10000,
+        resume_checkpoint: str = "",
+        gradient_clipping: float = -1.0,
+        weight_decay: float = 0.0,
+        learning_steps: int = 0,
+        eval_data: Optional[Iterator[Dict[str, np.ndarray]]] = None,
+        eval_callbacks: Sequence[Callable[["TrainLoop"], None]] = (),
+        mesh: Optional[Mesh] = None,
+        checkpoint_dir: str = "",
+        seed: int = 102,
+    ) -> None:
+        self.workload = model
+        self.data = data
+        self.eval_data = eval_data
+        self.eval_callbacks = list(eval_callbacks)
+        self.batch_size = batch_size
+        # microbatch default = whole batch (reference trainer.py:70)
+        self.microbatch = microbatch if microbatch > 0 else batch_size
+        if batch_size % self.microbatch:
+            raise ValueError(f"batch_size {batch_size} not divisible by "
+                             f"microbatch {self.microbatch} (static shapes)")
+        self.n_micro = batch_size // self.microbatch
+        self.lr = lr
+        self.ema_rates: Tuple[str, ...] = tuple(
+            r.strip() for r in str(ema_rate).split(",") if r.strip())
+        self.log_interval = log_interval
+        self.eval_interval = eval_interval
+        self.save_interval = save_interval
+        self.gradient_clipping = gradient_clipping
+        self.weight_decay = weight_decay
+        self.learning_steps = learning_steps
+        self.checkpoint_dir = checkpoint_dir or logger.get_dir() or ""
+
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        # global batch = per-host batch x hosts (reference trainer.py:89)
+        self.global_batch = batch_size * jax.process_count()
+        dpf = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        global_micro = self.microbatch * jax.process_count()
+        if global_micro % dpf:
+            raise ValueError(
+                f"global microbatch {global_micro} (= microbatch "
+                f"{self.microbatch} x {jax.process_count()} hosts) must be "
+                f"divisible by data x fsdp mesh axes = {dpf}")
+        self._base_rng = jax.random.PRNGKey(seed)
+
+        self._build_state(resume_checkpoint)
+        self._build_step_fns()
+
+        tokens_per_step = self.global_batch * self.workload.seq_len
+        self._timer = StepTimer(tokens_per_step)
+        self._flops_per_token = transformer_train_flops_per_token(
+            self.n_params, self.workload.num_layers,
+            self.workload.hidden_size, self.workload.seq_len)
+
+    # ------------------------------------------------------------ state setup
+
+    def _make_optimizer(self) -> optax.GradientTransformation:
+        """AdamW with the reference's linear anneal ``lr*(1-step/total)``
+        (trainer.py:257-263) and decoupled weight decay (trainer.py:99)."""
+        if self.learning_steps > 0:
+            sched = lambda step: self.lr * jnp.maximum(
+                0.0, 1.0 - step / self.learning_steps)
+        else:
+            sched = self.lr
+        return optax.adamw(sched, b1=0.9, b2=0.999, eps=1e-8,
+                           weight_decay=self.weight_decay)
+
+    def _build_state(self, resume_checkpoint: str) -> None:
+        wl = self.workload
+        init_rng = jax.random.fold_in(self._base_rng, 0)
+        abstract = jax.eval_shape(wl.init_params, init_rng)
+        pshard = param_shardings(self.mesh, abstract)
+        self._pshard = pshard
+        self.opt = self._make_optimizer()
+
+        # Optimizer-state shardings: params-shaped leaves (mu/nu) inherit the
+        # param shardings — the FSDP/ZeRO contract that keeps the 2x Adam
+        # memory sharded like the weights (SURVEY.md §7 hard parts) — and
+        # scalars (count) replicate. jit does NOT propagate input shardings
+        # to outputs, so this must be explicit.
+        from ..parallel.sharding import replicated
+        rep = replicated(self.mesh)
+        abstract_unboxed = nn.meta.unbox(abstract)
+        abstract_opt = jax.eval_shape(self.opt.init, abstract_unboxed)
+        oshard = optax.tree_map_params(
+            self.opt, lambda _, s: s, abstract_opt, pshard,
+            transform_non_params=lambda _: rep)
+
+        with self.mesh:
+            params = jax.jit(
+                lambda r: nn.meta.unbox(wl.init_params(r)),
+                out_shardings=pshard)(init_rng)
+            opt_state = jax.jit(self.opt.init, out_shardings=oshard)(params)
+            # Fresh EMA = copy of params (reference deepcopies,
+            # trainer.py:110-113). Distinct buffers, NOT aliases: the jitted
+            # step donates the whole state, and donating one buffer through
+            # several tree slots is an error.
+            ema = {r: jax.tree_util.tree_map(jnp.copy, params)
+                   for r in self.ema_rates}
+
+        self.n_params = wl.param_count(params)
+        self.step = 0
+
+        restored = ckpt_lib.restore_resume_state(
+            self.checkpoint_dir,
+            abstract_params=_abstract_like(params),
+            ema_rates=self.ema_rates,
+            abstract_opt=_abstract_like(opt_state),
+            explicit_model_path=resume_checkpoint,
+        )
+        if restored is not None:
+            self.step = restored["step"]
+            params = restored["params"]
+            ema = restored["ema"] or ema
+            if restored["opt_state"] is not None:
+                opt_state = restored["opt_state"]
+            logger.info(f"resumed from step {self.step} "
+                        f"({self.checkpoint_dir or resume_checkpoint})")
+
+        from ..parallel.sharding import replicated
+        self.state = TrainState(
+            step=jax.device_put(jnp.asarray(self.step, jnp.int32),
+                                replicated(self.mesh)),
+            params=params, opt_state=opt_state, ema=ema)
+
+    # ------------------------------------------------------------- step fns
+
+    def _build_step_fns(self) -> None:
+        wl = self.workload
+        clip = self.gradient_clipping
+        opt = self.opt
+        rates = self.ema_rates
+        pshard = self._pshard
+        base_rng = self._base_rng
+        if self.learning_steps > 0:
+            lr, total = self.lr, self.learning_steps
+            lr_at = lambda step: lr * jnp.maximum(0.0, 1.0 - step / total)
+        else:
+            lr_at = lambda step: jnp.asarray(self.lr)
+
+        def micro_scan(params: Any, batch: Dict[str, jnp.ndarray],
+                       rng: jax.Array, with_grad: bool):
+            """lax.scan over the [n_micro, ...] leading axis, accumulating
+            loss metrics (and grads) — the reference's inner microbatch loop
+            + DDP no_sync trick (trainer.py:230-235) with the single psum
+            emitted by XLA at the end."""
+            def loss_fn(p, mb, r):
+                d = wl.compute_losses(p, mb, r)
+                return d["loss"], d
+
+            def one(mb, i):
+                r = jax.random.fold_in(rng, i)
+                if with_grad:
+                    (_, d), g = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, mb, r)
+                    return g, d
+                _, d = loss_fn(params, mb, r)
+                return (), d
+
+            def body(carry, xs):
+                mb, i = xs
+                g, d = one(mb, i)
+                g_acc, m_acc = carry
+                if with_grad:
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                m_acc = jax.tree_util.tree_map(jnp.add, m_acc, d)
+                return (g_acc, m_acc), None
+
+            n_micro = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            # First microbatch runs outside the scan: its outputs give the
+            # carry its structure (no abstract tracing tricks needed).
+            g0, m0 = one(jax.tree_util.tree_map(lambda x: x[0], batch),
+                         jnp.asarray(0, jnp.int32))
+            if n_micro > 1:
+                rest = jax.tree_util.tree_map(lambda x: x[1:], batch)
+                (g, m), _ = jax.lax.scan(
+                    body, (g0, m0), (rest, jnp.arange(1, n_micro)))
+            else:
+                g, m = g0, m0
+            scale = 1.0 / n_micro
+            m = jax.tree_util.tree_map(lambda x: x * scale, m)
+            if with_grad:
+                g = jax.tree_util.tree_map(lambda x: x * scale, g)
+            return g, m
+
+        def train_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+            rng = jax.random.fold_in(base_rng, state.step)
+            grads, metrics = micro_scan(state.params, batch, rng,
+                                        with_grad=True)
+            gnorm = optax.global_norm(grads)
+            if clip > 0:  # reference grad_clip, trainer.py:246-255
+                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            updates, opt_state = opt.update(grads, state.opt_state,
+                                            state.params)
+            params = optax.apply_updates(state.params, updates)
+            params = jax.lax.with_sharding_constraint(params, pshard)
+            ema = {r: update_ema(state.ema[r], params, float(r))
+                   for r in rates}
+            metrics = dict(metrics)
+            metrics["grad_norm"] = gnorm          # device scalar — no sync
+            metrics["lr"] = lr_at(state.step)
+            new_state = TrainState(step=state.step + 1, params=params,
+                                   opt_state=opt_state, ema=ema)
+            return new_state, metrics
+
+        def eval_step(params: Any, batch: Dict[str, jnp.ndarray],
+                      rng: jax.Array):
+            _, metrics = micro_scan(params, batch, rng, with_grad=False)
+            return metrics
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+        self._batch_sharding = batch_shardings(self.mesh, microbatched=True)
+
+    # ------------------------------------------------------------- data prep
+
+    def _prepare(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        """Host batch [B, ...] -> global sharded [n_micro, B_micro_global, ...]."""
+        mb = self.microbatch
+        reshaped = {k: v.reshape((self.n_micro, mb) + v.shape[1:])
+                    for k, v in batch.items()}
+        return shard_batch(self.mesh, reshaped,
+                           sharding=self._batch_sharding, batch_axis=1)
+
+    # ------------------------------------------------------------- the loop
+
+    def run_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """One optimizer step (reference run_step, trainer.py:198-201)."""
+        with self.mesh:
+            self.state, metrics = self._train_step(self.state,
+                                                   self._prepare(batch))
+        self.step += 1
+        self._timer.tick()
+        logger.logkvs_mean(metrics)
+        self.log_step()
+        return metrics
+
+    def forward_only(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Eval pass without grads (reference forward_only trainer.py:223-228);
+        metrics are logged under an ``eval_`` prefix."""
+        # fold_in data must be uint32; offset eval streams away from the
+        # train stream (which folds in the raw step).
+        rng = jax.random.fold_in(self._base_rng, 0x7FFF0000 + self.step)
+        with self.mesh:
+            metrics = self._eval_step(self.state.params, self._prepare(batch),
+                                      rng)
+        logger.logkvs_mean({f"eval_{k}": v for k, v in metrics.items()})
+        return metrics
+
+    def log_step(self) -> None:
+        """step + cumulative samples (reference log_step trainer.py:273-275)."""
+        logger.logkv("step", self.step)
+        logger.logkv("samples", self.step * self.global_batch)
+
+    def _log_throughput(self) -> None:
+        sps, tps = self._timer.lap()
+        if tps > 0:
+            logger.logkv("steps_per_sec", round(sps, 4))
+            logger.logkv("tokens_per_sec", round(tps, 1))
+            logger.logkv("tokens_per_sec_per_chip",
+                         round(tps / jax.device_count(), 1))
+            logger.logkv("mfu", round(mfu(tps, self._flops_per_token), 4))
+
+    def run_loop(self) -> None:
+        """Interval-driven outer loop (reference run_loop trainer.py:175-196):
+        log every ``log_interval``, eval every ``eval_interval``, save every
+        ``save_interval``, final save on exit."""
+        while self.learning_steps <= 0 or self.step < self.learning_steps:
+            batch = next(self.data)
+            self.run_step(batch)
+            if self.step % self.log_interval == 0:
+                self._log_throughput()
+                logger.dumpkvs()
+            if self.eval_data is not None and self.step % self.eval_interval == 0:
+                self.forward_only(next(self.eval_data))
+                if jax.process_index() == 0:
+                    for cb in self.eval_callbacks:
+                        cb(self)
+            if self.step % self.save_interval == 0:
+                self.save()
+        if self.step % self.save_interval != 0:
+            self.save()
+
+    __call__ = run_loop  # reference trainer.py:357
+
+    # ------------------------------------------------------------ checkpoint
+
+    def save(self) -> None:
+        """model_/ema_{rate}_/opt_{step:06d} under the run dir (reference
+        save(), trainer.py:277-302)."""
+        if not self.checkpoint_dir:
+            logger.warn("no checkpoint_dir configured; skipping save")
+            return
+        ckpt_lib.save_checkpoint(
+            self.checkpoint_dir, self.step, self.state.params,
+            ema={r: self.state.ema[r] for r in self.ema_rates},
+            opt_state=self.state.opt_state)
+        logger.info(f"saved checkpoint at step {self.step} "
+                    f"-> {self.checkpoint_dir}")

@@ -1,0 +1,249 @@
+"""TrainLoop tests: the jitted-step engine, sharding, checkpoint/resume.
+
+Covers the reference-parity semantics SURVEY.md §4 lists as test-worthy:
+EMA math (trainer.py:360-370), LR anneal (:257-263), grad clip (:246-255),
+microbatch accumulation equivalence (:230-235), checkpoint filename
+convention and auto-resume (:319-355) — all on a real 8-device mesh.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.data import load_data_from_args
+from distributed_pipeline_tpu.models import create_model_from_config
+from distributed_pipeline_tpu.parallel import make_mesh
+from distributed_pipeline_tpu.parallel.sharding import (
+    batch_shardings,
+    param_shardings,
+    shard_batch,
+)
+from distributed_pipeline_tpu.utils import checkpoint as ckpt
+from distributed_pipeline_tpu.utils import logger
+from distributed_pipeline_tpu.utils.trainer import TrainLoop, update_ema
+
+
+def tiny_workload(fam="gpt2", seq_len=16):
+    return create_model_from_config(
+        model_family=fam, vocab_size=64, seq_len=seq_len, hidden_size=32,
+        num_layers=2, num_heads=2, diffusion_steps=50, dtype="float32")
+
+
+def tiny_data(fam="gpt2", batch_size=8, seq_len=16, seed=0):
+    name = "synthetic-lm" if fam == "gpt2" else "synthetic-seq2seq"
+    return load_data_from_args("train", batch_size=batch_size, dataset=name,
+                               seq_len=seq_len, vocab_size=64, seed=seed)
+
+
+def make_loop(tmp_path, fam="gpt2", **kw):
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("lr", 1e-3)
+    kw.setdefault("learning_steps", 1000)
+    kw.setdefault("log_interval", 1000)
+    kw.setdefault("save_interval", 10 ** 9)
+    kw.setdefault("mesh", make_mesh(dp=8))
+    kw.setdefault("ema_rate", "0.9")
+    kw.setdefault("seed", 5)
+    data = kw.pop("data", None) or tiny_data(fam, kw["batch_size"])
+    return TrainLoop(model=tiny_workload(fam), data=data,
+                     checkpoint_dir=str(tmp_path), **kw)
+
+
+# --------------------------------------------------------------- core engine
+
+def test_loss_decreases_over_steps(tmp_path):
+    loop = make_loop(tmp_path)
+    first = float(loop.run_step(next(loop.data))["loss"])
+    for _ in range(30):
+        m = loop.run_step(next(loop.data))
+    assert float(m["loss"]) < first
+    assert loop.step == 31
+
+
+def test_grad_accumulation_equivalence(tmp_path):
+    """microbatch=B vs microbatch=B/4 must produce identical updates for an
+    rng-independent loss (the reference's no_sync accumulation semantics)."""
+    batches = [next(tiny_data("gpt2", 8, seed=1)) for _ in range(2)]
+    results = []
+    for mb in (8, 2):
+        it = iter(batches)
+        loop = make_loop(tmp_path / f"mb{mb}", microbatch=mb, data=it,
+                         mesh=make_mesh(dp=2, fsdp=1, tensor=1, sequence=1,
+                                        devices=jax.devices()[:2]))
+        for b in batches:
+            loop.run_step(b)
+        results.append(jax.tree_util.tree_leaves(loop.state.params))
+    for a, b in zip(*results):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_lr_anneal_linear(tmp_path):
+    loop = make_loop(tmp_path, lr=1e-2, learning_steps=100)
+    m = loop.run_step(next(loop.data))
+    # step 0 metric: lr * (1 - 0/100)
+    np.testing.assert_allclose(float(m["lr"]), 1e-2, rtol=1e-6)
+    for _ in range(9):
+        m = loop.run_step(next(loop.data))
+    np.testing.assert_allclose(float(m["lr"]), 1e-2 * (1 - 9 / 100), rtol=1e-5)
+
+
+def test_grad_clip_changes_update_and_logs_preclip_norm(tmp_path):
+    """Clip rescales grads before Adam (reference grad_clip trainer.py:
+    246-255); the logged norm is the pre-clip norm. (Adam is scale-invariant
+    in the long run but a one-step update still differs under clipping.)"""
+    batch = next(tiny_data("gpt2", 8, seed=4))
+    outs = {}
+    for clip in (-1.0, 1e-3):
+        loop = make_loop(tmp_path / f"clip{clip}", gradient_clipping=clip,
+                         data=iter([batch]))
+        m = loop.run_step(batch)
+        outs[clip] = (float(m["grad_norm"]),
+                      jax.tree_util.tree_leaves(loop.state.params))
+    # same pre-clip grad norm logged in both runs
+    np.testing.assert_allclose(outs[-1.0][0], outs[1e-3][0], rtol=1e-5)
+    assert outs[-1.0][0] > 1e-3  # clip threshold actually binds
+    diffs = [np.abs(np.asarray(a) - np.asarray(b)).max()
+             for a, b in zip(outs[-1.0][1], outs[1e-3][1])]
+    assert max(diffs) > 1e-6  # clipping altered the first-step update
+
+
+def test_ema_update_math():
+    ema = {"w": jnp.ones((4,))}
+    params = {"w": jnp.zeros((4,))}
+    out = update_ema(ema, params, 0.9)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.9)
+
+
+def test_ema_tracks_params(tmp_path):
+    loop = make_loop(tmp_path, ema_rate="0.5,0.99")
+    for _ in range(5):
+        loop.run_step(next(loop.data))
+    p = jax.tree_util.tree_leaves(loop.state.params)
+    fast = jax.tree_util.tree_leaves(loop.state.ema["0.5"])
+    slow = jax.tree_util.tree_leaves(loop.state.ema["0.99"])
+    dist_fast = sum(float(jnp.sum((a - b) ** 2)) for a, b in zip(p, fast))
+    dist_slow = sum(float(jnp.sum((a - b) ** 2)) for a, b in zip(p, slow))
+    assert 0 < dist_fast < dist_slow  # fast EMA hugs params closer
+
+
+def test_microbatch_validation(tmp_path):
+    with pytest.raises(ValueError):
+        make_loop(tmp_path, batch_size=8, microbatch=3)
+
+
+def test_eval_step_and_metrics(tmp_path):
+    loop = make_loop(tmp_path)
+    m = loop.forward_only(next(loop.data))
+    assert "loss" in m and np.isfinite(float(m["loss"]))
+
+
+# ----------------------------------------------------------------- sharding
+
+def test_params_are_fsdp_sharded(tmp_path):
+    mesh = make_mesh(dp=2, fsdp=4)
+    loop = make_loop(tmp_path, mesh=mesh)
+    flat = jax.tree_util.tree_leaves_with_path(loop.state.params)
+    sharded = [
+        (jax.tree_util.keystr(p), l.sharding.spec)
+        for p, l in flat
+        if any(ax == "fsdp" or (isinstance(ax, tuple) and "fsdp" in ax)
+               for ax in (l.sharding.spec or ()))
+    ]
+    assert sharded, "no parameter was sharded over the fsdp axis"
+    # optimizer mu/nu must shard like params (ZeRO memory contract)
+    mu_leaves = jax.tree_util.tree_leaves(loop.state.opt_state[0].mu)
+    p_leaves = jax.tree_util.tree_leaves(loop.state.params)
+    for m, p in zip(mu_leaves, p_leaves):
+        assert m.sharding == p.sharding
+
+
+@pytest.mark.parametrize("axes", [dict(dp=2, fsdp=2, tensor=2),
+                                  dict(dp=1, fsdp=1, tensor=8)])
+def test_train_step_runs_on_mixed_mesh(tmp_path, axes):
+    """DP x FSDP x TP and pure-TP meshes compile and run the same engine
+    (strategy = sharding spec, no new code — SURVEY.md §2.2 payoff)."""
+    mesh = make_mesh(**axes)
+    loop = make_loop(tmp_path / "mixed", mesh=mesh, batch_size=8, microbatch=4)
+    m1 = loop.run_step(next(loop.data))
+    m2 = loop.run_step(next(loop.data))
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_dp_invariance_across_meshes(tmp_path):
+    """The same data must give the same loss no matter how it is sharded."""
+    batches = [next(tiny_data("gpt2", 8, seed=9)) for _ in range(1)]
+    losses = []
+    for axes in (dict(dp=8), dict(dp=2, fsdp=4), dict(dp=4, tensor=2)):
+        loop = make_loop(tmp_path / str(axes), mesh=make_mesh(**axes),
+                         data=iter(batches))
+        losses.append(float(loop.run_step(batches[0])["loss"]))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    np.testing.assert_allclose(losses[0], losses[2], rtol=1e-5)
+
+
+def test_shard_batch_layout():
+    mesh = make_mesh(dp=8)
+    b = {"x": np.arange(64, dtype=np.int32).reshape(8, 8)}
+    g = shard_batch(mesh, b)
+    assert g["x"].shape == (8, 8)
+    assert g["x"].sharding.spec == batch_shardings(mesh).spec
+
+
+# ------------------------------------------------------------- checkpointing
+
+def test_parse_step_from_name():
+    assert ckpt.parse_step_from_name("model_012345") == 12345
+    assert ckpt.parse_step_from_name("ema_0.99_000020") == 20
+    assert ckpt.parse_step_from_name("model_") is None
+
+
+def test_checkpoint_roundtrip_and_discovery(tmp_path):
+    d = str(tmp_path)
+    tree = {"a": jnp.arange(8.0), "b": {"c": jnp.ones((2, 2))}}
+    ckpt.save_checkpoint(d, 7, tree)
+    ckpt.save_checkpoint(d, 20, jax.tree_util.tree_map(lambda x: x * 2, tree))
+    assert ckpt.latest_step(d) == 20
+    assert ckpt.find_resume_checkpoint(d).endswith("model_000020")
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+    restored = ckpt.restore_checkpoint(os.path.join(d, "model_000007"),
+                                       abstract)
+    np.testing.assert_allclose(np.asarray(restored["a"]),
+                               np.arange(8.0))
+
+
+def test_resume_continues_training(tmp_path):
+    loop = make_loop(tmp_path, save_interval=10 ** 9)
+    for _ in range(3):
+        loop.run_step(next(loop.data))
+    loop.save()
+    # new loop in the same dir auto-discovers and resumes
+    loop2 = make_loop(tmp_path)
+    assert loop2.step == 3
+    assert int(loop2.state.step) == 3
+    for a, b in zip(jax.tree_util.tree_leaves(loop.state.params),
+                    jax.tree_util.tree_leaves(loop2.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # EMA survived too
+    for a, b in zip(jax.tree_util.tree_leaves(loop.state.ema["0.9"]),
+                    jax.tree_util.tree_leaves(loop2.state.ema["0.9"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    m = loop2.run_step(next(loop2.data))
+    assert loop2.step == 4 and np.isfinite(float(m["loss"]))
+
+
+def test_resume_across_mesh_change(tmp_path):
+    """Checkpoints are topology-independent: save on dp=8, resume on
+    dp=2 x fsdp=4 (elastic-recovery story, SURVEY.md §5.3)."""
+    loop = make_loop(tmp_path, mesh=make_mesh(dp=8))
+    loop.run_step(next(loop.data))
+    loop.save()
+    loop2 = make_loop(tmp_path, mesh=make_mesh(dp=2, fsdp=4))
+    assert loop2.step == 1
+    for a, b in zip(jax.tree_util.tree_leaves(loop.state.params),
+                    jax.tree_util.tree_leaves(loop2.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
