@@ -37,17 +37,12 @@ def main() -> None:
         model_family="diffuseq", model_size="base", vocab_size=8192,
         seq_len=seq_len, dtype="bfloat16" if on_tpu else "float32")
 
-    def batches():
-        import numpy as np
-        rng = np.random.default_rng(0)
-        while True:
-            ids = rng.integers(4, 8192, (batch, seq_len)).astype(np.int32)
-            mask = np.zeros((batch, seq_len), np.int32)
-            mask[:, seq_len // 2:] = 1
-            yield {"input_ids": ids, "input_mask": mask,
-                   "pad_mask": np.ones((batch, seq_len), np.int32)}
+    from distributed_pipeline_tpu.data import load_data_from_args
+    data = load_data_from_args("train", batch_size=batch,
+                               dataset="synthetic-seq2seq", seq_len=seq_len,
+                               vocab_size=8192, seed=0, num_loader_proc=2)
 
-    loop = TrainLoop(model=wl, data=batches(), batch_size=batch,
+    loop = TrainLoop(model=wl, data=data, batch_size=batch,
                      microbatch=batch, lr=1e-4, ema_rate="0.9999",
                      learning_steps=0, log_interval=10 ** 9,
                      save_interval=10 ** 9, mesh=make_mesh(dp=-1),

@@ -80,7 +80,8 @@ class DiffuSeqModel(nn.Module):
             (self.seq_len, self.hidden_size), jnp.float32)
         self.backbone = TransformerBackbone(
             self.num_layers, self.num_heads, self.dtype, self.remat,
-            self.attention_impl, name="backbone")
+            causal=False, attention_impl=self.attention_impl,
+            name="backbone")
         self.out_proj = nn.Dense(
             self.emb_dim, kernel_init=nn.with_logical_partitioning(
                 _dense_init(self.hidden_size), (EMBED, None)),

@@ -9,9 +9,10 @@ dispatcher so models never hard-code a kernel choice:
 * ``impl="pallas"`` — FlashAttention-style blocked kernel written in Pallas
                       (ops/flash_attention.py); O(L) memory, wins at long L.
 * ``impl="ring"``   — ring attention over the ``sequence`` mesh axis for
-                      context parallelism (parallel/ring.py); composes with
-                      blockwise attention per ring step.
-* ``impl="auto"``   — picks per platform/shape.
+                      context parallelism (parallel/ring.py): K/V shards
+                      rotate via ``ppermute`` with online-softmax folding.
+* ``impl="auto"``   — ring when the ambient mesh has a sequence axis > 1,
+                      else pallas on TPU for long sequences, else XLA.
 
 The interface is structural — ``(q, k, v, pad_mask [B, L], causal)`` — not a
 dense additive bias: materializing a [B, 1, L, L] bias in HBM would defeat the
@@ -76,15 +77,19 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     sequences and XLA einsum otherwise.
     """
     if impl == "auto":
-        on_tpu = jax.default_backend() == "tpu"
-        impl = "pallas" if (on_tpu and q.shape[-2] >= 512) else "xla"
+        from ..parallel.ring import current_mesh
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+            impl = "ring"  # sequence-parallel mesh: attention must ring
+        else:
+            on_tpu = jax.default_backend() == "tpu"
+            impl = "pallas" if (on_tpu and q.shape[-2] >= 512) else "xla"
     if impl == "xla":
         return _xla_attention(q, k, v, pad_mask, causal)
     if impl == "pallas":
         from .flash_attention import flash_attention
         return flash_attention(q, k, v, pad_mask, causal)
     if impl == "ring":
-        raise ValueError(
-            "ring attention is mesh-scoped; call parallel.ring.ring_attention "
-            "inside shard_map rather than through this dispatcher")
+        from ..parallel.ring import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, pad_mask, causal)
     raise ValueError(f"unknown attention impl: {impl}")

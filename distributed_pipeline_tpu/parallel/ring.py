@@ -1,0 +1,153 @@
+"""Ring attention: sequence/context parallelism over the ``sequence`` mesh axis.
+
+The reference has no long-context machinery at all (SURVEY.md §5.7 — its max
+context is whatever the user model fits on one GPU). Here long context is
+first-class: activations shard over sequence ([B, H, L/n, Dh] per chip) and
+attention runs as a ring — each chip holds its query shard, while key/value
+shards rotate around the ``sequence`` axis via ``ppermute`` (ICI
+neighbor-to-neighbor, the topology TPU ICI is best at). Per hop, a chip
+folds the visiting K/V block into a running online-softmax state
+(FlashAttention-style max/normalizer/accumulator), so
+
+* memory per chip stays O(L/n) for activations and O((L/n)^2) for scores;
+* compute and communication overlap naturally (the next block can be in
+  flight while the current one multiplies);
+* the math is EXACTLY softmax attention — tests assert parity with the
+  dense XLA path, gradients included (``ppermute`` is differentiable).
+
+Causal masking uses global offsets derived from each block's source shard
+index, so rotated blocks mask correctly. Compute stays uniform across hops
+(fully-masked hops are masked, not skipped) — SPMD programs must not branch
+per device.
+
+Usage: inside ``shard_map`` (models get there via
+``ops.attention.dot_product_attention(impl="ring")`` which wraps this in a
+``shard_map`` over the ambient mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+__all__ = ["ring_attention", "ring_attention_sharded", "current_mesh"]
+
+
+def current_mesh():
+    """The ambient ``with mesh:`` context's mesh (None outside one)."""
+    from jax._src.mesh import thread_resources
+    m = thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def _block_attn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                kmask: Optional[jnp.ndarray], causal: bool,
+                q_off: jnp.ndarray, k_off: jnp.ndarray,
+                sm_scale: float) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One (q-shard x kv-block) attention piece -> (exp-weighted values,
+    row max, row normalizer), f32. Shapes: q [B,H,Lq,D], k/v [B,H,Lk,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if kmask is not None:
+        s = s + (1.0 - kmask.astype(jnp.float32))[:, None, None, :] * NEG_INF
+    if causal:
+        Lq, Lk = q.shape[-2], k.shape[-2]
+        rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                    # [B,H,Lq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    return pv, m, l
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   pad_mask: Optional[jnp.ndarray] = None,
+                   causal: bool = False,
+                   axis_name: str = "sequence") -> jnp.ndarray:
+    """Exact attention over sequence-sharded [B, H, L_local, Dh] inputs.
+    Must run inside ``shard_map`` with ``axis_name`` bound."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    L_local = q.shape[-2]
+    sm_scale = q.shape[-1] ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]  # rotate kv around the ring
+    q_off = my * L_local
+
+    def hop(carry, i):
+        k_blk, v_blk, mask_blk, acc, m_run, l_run = carry
+        src = (my - i) % n                # shard that produced this kv block
+        pv, m_blk, l_blk = _block_attn(q, k_blk, v_blk, mask_blk, causal,
+                                       q_off, src * L_local, sm_scale)
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc = acc * alpha + pv * beta
+        l_run = l_run * alpha + l_blk * beta
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_nxt = (jax.lax.ppermute(mask_blk, axis_name, perm)
+                    if mask_blk is not None else None)
+        return (k_nxt, v_nxt, mask_nxt, acc, m_new, l_run), None
+
+    B, H, _, D = q.shape
+    acc0 = jnp.zeros((B, H, L_local, D), jnp.float32)
+    m0 = jnp.full((B, H, L_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, L_local, 1), jnp.float32)
+    (_, _, _, acc, _, l), _ = jax.lax.scan(
+        hop, (k, v, pad_mask, acc0, m0, l0), jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
+
+
+def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           pad_mask: Optional[jnp.ndarray] = None,
+                           causal: bool = False,
+                           mesh=None) -> jnp.ndarray:
+    """Ring attention on GLOBAL [B, H, L, Dh] arrays: wraps
+    :func:`ring_attention` in ``shard_map`` over the ambient (or given) mesh,
+    sharding batch over (data, fsdp), heads over tensor, sequence over the
+    ring axis."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        raise ValueError("ring attention needs a mesh: run inside `with mesh:`"
+                         " or pass mesh=")
+    B, H, L, _ = q.shape
+    sp = mesh.shape["sequence"]
+    if L % sp:
+        raise ValueError(f"sequence length {L} not divisible by the "
+                         f"sequence mesh axis ({sp})")
+    # Shard batch/heads only over axes whose size divides them (a B=1 init
+    # trace must still work on a dp>1 mesh — axes that don't divide fall
+    # back to replication).
+    batch_axes, rem = [], B
+    for a in ("data", "fsdp"):
+        if mesh.shape[a] > 1 and rem % mesh.shape[a] == 0:
+            batch_axes.append(a)
+            rem //= mesh.shape[a]
+    batch = tuple(batch_axes) or None
+    heads = ("tensor" if mesh.shape["tensor"] > 1 and H % mesh.shape["tensor"] == 0
+             else None)
+    qkv_spec = P(batch, heads, "sequence", None)
+    mask_spec = P(batch, "sequence")
+
+    if pad_mask is None:
+        fn = shard_map(
+            functools.partial(ring_attention, pad_mask=None, causal=causal),
+            mesh=mesh, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
+            check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(
+        functools.partial(ring_attention, causal=causal),
+        mesh=mesh, in_specs=(qkv_spec,) * 3 + (mask_spec,),
+        out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, pad_mask)

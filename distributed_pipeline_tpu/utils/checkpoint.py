@@ -133,8 +133,14 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
     directory = os.path.dirname(model_path)
     for rate in ema_rates:
         p = find_ema_checkpoint(directory, step, rate)
-        out["ema"][rate] = (restore_checkpoint(p, abstract_params)
-                            if p else params)
+        if p:
+            out["ema"][rate] = restore_checkpoint(p, abstract_params)
+        else:
+            # Missing companion degrades to a COPY of params (reference seeds
+            # EMA from params, trainer.py:110-113) — never an alias, which
+            # would be donated twice by the jitted step and crash.
+            import jax.numpy as jnp
+            out["ema"][rate] = jax.tree_util.tree_map(jnp.copy, params)
     if abstract_opt is not None:
         p = find_opt_checkpoint(directory, step)
         if p:

@@ -17,24 +17,28 @@ import jax
 __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
            "StepTimer", "mfu"]
 
-# Peak dense bf16 FLOP/s per chip (public spec sheets). CPU entry keeps the
-# gauge meaningful in tests.
-_PEAK_FLOPS = {
-    "v2": 45e12, "v3": 123e12, "v4": 275e12, "v5e": 197e12,
-    "v5p": 459e12, "v6e": 918e12, "v6p": 4614e12 / 2,  # v6p per-chip bf16
-    "cpu": 1e11,
-}
+# Peak dense bf16 FLOP/s per chip (public spec sheets), matched IN ORDER
+# against jax's device_kind strings — real hardware reports e.g.
+# "TPU v5 lite" (v5e) and "TPU v5p", so specific patterns come first.
+# CPU entry keeps the gauge meaningful in tests.
+_PEAK_FLOPS = (
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ("cpu", 1e11),
+)
 
 
 def device_peak_flops(device: Optional[jax.Device] = None) -> float:
     d = device or jax.devices()[0]
     kind = getattr(d, "device_kind", "cpu").lower()
-    for key, flops in _PEAK_FLOPS.items():
+    for key, flops in _PEAK_FLOPS:
         if key in kind:
             return flops
     if d.platform == "tpu":  # unknown TPU generation: assume v4-class
-        return _PEAK_FLOPS["v4"]
-    return _PEAK_FLOPS["cpu"]
+        return 275e12
+    return 1e11
 
 
 def transformer_train_flops_per_token(n_params: int, n_layers: int,

@@ -43,7 +43,12 @@ from jax.sharding import Mesh
 
 from ..models import Workload
 from ..parallel import mesh as mesh_lib
-from ..parallel.sharding import batch_shardings, param_shardings, shard_batch
+from ..parallel.sharding import (
+    batch_shardings,
+    param_shardings,
+    replicated,
+    shard_batch,
+)
 from . import checkpoint as ckpt_lib
 from . import logger
 from .perf import StepTimer, device_peak_flops, mfu, \
@@ -173,7 +178,6 @@ class TrainLoop:
         # memory sharded like the weights (SURVEY.md §7 hard parts) — and
         # scalars (count) replicate. jit does NOT propagate input shardings
         # to outputs, so this must be explicit.
-        from ..parallel.sharding import replicated
         rep = replicated(self.mesh)
         abstract_unboxed = nn.meta.unbox(abstract)
         abstract_opt = jax.eval_shape(self.opt.init, abstract_unboxed)
@@ -212,7 +216,6 @@ class TrainLoop:
             logger.info(f"resumed from step {self.step} "
                         f"({self.checkpoint_dir or resume_checkpoint})")
 
-        from ..parallel.sharding import replicated
         self.state = TrainState(
             step=jax.device_put(jnp.asarray(self.step, jnp.int32),
                                 replicated(self.mesh)),
@@ -306,7 +309,12 @@ class TrainLoop:
 
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
         self._eval_step = jax.jit(eval_step)
-        self._batch_sharding = batch_shardings(self.mesh, microbatched=True)
+        # Sequence-parallel meshes shard the batch's L axis too, so each chip
+        # only ever holds its L/n activation slice (ring attention does the
+        # cross-shard interaction).
+        self._batch_sharding = batch_shardings(
+            self.mesh, microbatched=True,
+            seq_sharded=self.mesh.shape["sequence"] > 1)
 
     # ------------------------------------------------------------- data prep
 

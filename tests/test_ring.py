@@ -1,0 +1,97 @@
+"""Ring attention (sequence/context parallelism) tests: exact parity with
+dense attention on an 8-device mesh, gradients included, plus a full
+sequence-parallel training step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.data import load_data_from_args
+from distributed_pipeline_tpu.models import create_model_from_config
+from distributed_pipeline_tpu.ops.attention import (
+    _xla_attention,
+    dot_product_attention,
+)
+from distributed_pipeline_tpu.parallel import make_mesh
+from distributed_pipeline_tpu.parallel.ring import ring_attention_sharded
+from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+
+def _qkv(rng, B=2, H=2, L=64, Dh=16):
+    ks = jax.random.split(jax.random.PRNGKey(rng), 3)
+    return [jax.random.normal(k, (B, H, L, Dh), jnp.float32) for k in ks]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense(causal, sp):
+    q, k, v = _qkv(0)
+    mesh = make_mesh(dp=1, sequence=sp, devices=jax.devices()[:sp])
+    ref = _xla_attention(q, k, v, None, causal)
+    with mesh:
+        out = ring_attention_sharded(q, k, v, None, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_with_pad_mask():
+    q, k, v = _qkv(1)
+    mask = jnp.asarray(np.repeat([[1] * 40 + [0] * 24], 2, axis=0))
+    mesh = make_mesh(dp=1, sequence=4, devices=jax.devices()[:4])
+    ref = _xla_attention(q, k, v, mask, False)
+    with mesh:
+        out = ring_attention_sharded(q, k, v, mask, False)
+    np.testing.assert_allclose(np.asarray(out)[:, :, :40],
+                               np.asarray(ref)[:, :, :40],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match_dense():
+    q, k, v = _qkv(2, L=32)
+    mesh = make_mesh(dp=2, sequence=4)
+
+    def loss_ring(q, k, v):
+        with mesh:
+            return jnp.sum(ring_attention_sharded(q, k, v, None, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, True) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_dispatcher_auto_picks_ring_under_sp_mesh():
+    q, k, v = _qkv(3, L=32)
+    mesh = make_mesh(dp=2, sequence=4)
+    ref = _xla_attention(q, k, v, None, False)
+    with mesh:
+        out = dot_product_attention(q, k, v, impl="auto")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("fam", ["diffuseq", "gpt2"])
+def test_sequence_parallel_train_step(tmp_path, fam):
+    """Full jitted training step on a dp=2 x sequence=4 mesh: activations
+    shard over L, attention rings, loss matches the dp-only mesh."""
+    wl = create_model_from_config(
+        model_family=fam, vocab_size=64, seq_len=32, hidden_size=32,
+        num_layers=2, num_heads=2, diffusion_steps=50, dtype="float32")
+    name = "synthetic-lm" if fam == "gpt2" else "synthetic-seq2seq"
+    batch = next(load_data_from_args("train", batch_size=8, dataset=name,
+                                     seq_len=32, vocab_size=64, seed=2))
+    losses = {}
+    for axes in (dict(dp=8), dict(dp=2, sequence=4)):
+        loop = TrainLoop(model=wl, data=iter([batch]), batch_size=8,
+                         lr=1e-3, learning_steps=10, log_interval=10 ** 6,
+                         save_interval=10 ** 9, mesh=make_mesh(**axes),
+                         checkpoint_dir=str(tmp_path / str(axes)), seed=5,
+                         ema_rate="0.9")
+        losses[str(axes)] = float(loop.run_step(batch)["loss"])
+    vals = list(losses.values())
+    np.testing.assert_allclose(vals[0], vals[1], rtol=1e-4)
