@@ -42,25 +42,32 @@ def main() -> None:
                                dataset="synthetic-seq2seq", seq_len=seq_len,
                                vocab_size=8192, seed=0, num_loader_proc=2)
 
-    loop = TrainLoop(model=wl, data=data, batch_size=batch,
-                     microbatch=batch, lr=1e-4, ema_rate="0.9999",
-                     learning_steps=0, log_interval=10 ** 9,
-                     save_interval=10 ** 9, mesh=make_mesh(dp=-1),
-                     checkpoint_dir="", seed=0)
-
-    # warmup (compile) then timed window
-    m = loop.run_step(next(loop.data))
-    jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
+    def measure(microbatch: int):
+        """tokens/sec (global: per-host batch x hosts, trainer.py:89) for one
+        accumulation config; warmup step compiles, then a timed window."""
+        loop = TrainLoop(model=wl, data=data, batch_size=batch,
+                         microbatch=microbatch, lr=1e-4, ema_rate="0.9999",
+                         learning_steps=0, log_interval=10 ** 9,
+                         save_interval=10 ** 9, mesh=make_mesh(dp=-1),
+                         checkpoint_dir="", seed=0)
         m = loop.run_step(next(loop.data))
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m = loop.run_step(next(loop.data))
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+        return steps * batch * seq_len * jax.process_count() / dt, loop.n_params
 
-    tokens_per_sec = steps * batch * seq_len / dt
+    # headline: no accumulation (BASELINE config 2 shape) ...
+    tokens_per_sec, n_params = measure(microbatch=batch)
+    # ... plus the grad-accum path (BASELINE config 3: microbatch < batch,
+    # lax.scan accumulation inside the jitted step).
+    accum_tokens_per_sec, _ = measure(microbatch=max(batch // 4, 1))
+
     per_chip = tokens_per_sec / jax.device_count()
     fpt = transformer_train_flops_per_token(
-        loop.n_params, wl.num_layers, wl.hidden_size, seq_len)
+        n_params, wl.num_layers, wl.hidden_size, seq_len)
     achieved_mfu = mfu(tokens_per_sec, fpt)
     print(json.dumps({
         "metric": "tokens/sec/chip (DiffuSeq-base seq128 train, "
@@ -69,7 +76,10 @@ def main() -> None:
         "unit": "tokens/s/chip",
         "vs_baseline": round(achieved_mfu / 0.40, 4),
         "mfu": round(achieved_mfu, 4),
-        "n_params": loop.n_params,
+        "grad_accum_tokens_per_sec_per_chip": round(
+            accum_tokens_per_sec / jax.device_count(), 1),
+        "grad_accum_mfu": round(mfu(accum_tokens_per_sec, fpt), 4),
+        "n_params": n_params,
         "n_devices": jax.device_count(),
     }))
 

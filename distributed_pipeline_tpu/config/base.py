@@ -138,6 +138,7 @@ class ArgparseCompatibleBaseModel(BaseModel):
         namespace, any remaining attribute is a programming error.
         """
         ns = vars(namespace)
+        ns.pop("_parsed_argv", None)  # bookkeeping from from_argv, not a field
         values = cls._pop_from_dict(ns)
         if _consume and ns:
             raise ValueError(
@@ -160,7 +161,12 @@ class ArgparseCompatibleBaseModel(BaseModel):
     @classmethod
     def from_argv(cls, argv: Optional[Sequence[str]] = None):
         parser = cls.to_argparse()
-        return cls.from_argparse(parser.parse_args(argv))
+        ns = parser.parse_args(argv)
+        # Record which argv this namespace came from, so downstream checks
+        # (e.g. TrainSettings' --config_json exclusivity) inspect the actual
+        # parsed command line, not the hosting process's sys.argv.
+        ns._parsed_argv = list(argv) if argv is not None else None
+        return cls.from_argparse(ns)
 
     # ------------------------------------------------------------------ JSON
     @classmethod

@@ -39,6 +39,11 @@ class GeneralSettings(S):
     checkpoint_path: str = _("", "run/checkpoint directory (auto-generated if empty)")
     gradient_clipping: float = _(-1.0, "global-norm gradient clip; <=0 disables")
     weight_decay: float = _(0.0, "AdamW decoupled weight decay")
+    debug_nans: bool = _(False, "enable jax_debug_nans: fail loudly at the op "
+                                "that first produces a NaN (debug runs only; "
+                                "disables async dispatch)")
+    profile_dir: str = _("", "capture a jax.profiler trace of a few steps "
+                             "into this directory (TensorBoard format)")
 
 
 class DataSettings(S):
@@ -100,17 +105,35 @@ class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):
 
     @classmethod
     def from_argparse(cls, namespace: argparse.Namespace, _consume: bool = True):  # type: ignore[override]
+        parsed_argv = vars(namespace).pop("_parsed_argv", "absent")
         config_json = vars(namespace).pop("config_json", None)
         if config_json:
+            # True mutual exclusivity (reference's mutually-exclusive group,
+            # config/train.py:63-67): a flag explicitly set to its default
+            # value still conflicts, so check the actual command line — the
+            # argv recorded by from_argv when one was given, else the
+            # process argv — with value-vs-default drift as the fallback for
+            # programmatic namespaces built without any command line.
+            import sys
+            if parsed_argv == "absent" or parsed_argv is None:
+                argv = sys.argv[1:]
+            else:
+                argv = parsed_argv
+            fields = set(cls.model_fields)
+            explicit = sorted({
+                tok.split("=")[0].lstrip("-") for tok in argv
+                if tok.startswith("--")
+                and tok.split("=")[0].lstrip("-") in fields})
             defaults = cls()
-            overridden = [
+            drifted = [
                 k for k, v in vars(namespace).items()
                 if hasattr(defaults, k) and getattr(defaults, k) != v
             ]
+            overridden = sorted(set(explicit) | set(drifted))
             if overridden:
                 raise SystemExit(
                     f"--config_json is mutually exclusive with individual flags "
-                    f"(got: {', '.join('--' + k for k in sorted(overridden))})"
+                    f"(got: {', '.join('--' + k for k in overridden)})"
                 )
             return cls.parse_file(config_json)
         return super().from_argparse(namespace, _consume=_consume)

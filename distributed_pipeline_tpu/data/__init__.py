@@ -106,19 +106,32 @@ def batch_iterator(dataset: Any, batch_size: int, *, shuffle: bool = True,
             f"dataset of {n} items cannot fill one global batch of "
             f"{batch_size}x{process_count} without looping")
 
-    def gen() -> Iterator[Dict[str, np.ndarray]]:
+    def gen(worker_id: int = 0, stride: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield every ``stride``-th batch starting at ``worker_id``. Skipped
+        batches only consume (cheap) indices, never materialize items — this
+        is what lets N producer threads split the item-synthesis work while
+        the interleaved stream stays identical to the single-producer order.
+        """
         idx_stream = _host_index_stream(
             n, shuffle=shuffle, seed=seed, process_index=process_index,
             process_count=process_count, loop=loop)
+        b = 0
         while True:
+            mine = b % stride == worker_id
+            taken = 0
             items = []
             for idx in idx_stream:
-                items.append(dataset[idx])
-                if len(items) == batch_size:
+                taken += 1
+                if mine:
+                    items.append(dataset[idx])
+                if taken == batch_size:
                     break
-            if len(items) < batch_size:
+            if taken < batch_size:
                 return  # non-loop tail: drop ragged batch (static shapes)
-            yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+            if mine:
+                yield {k: np.stack([it[k] for it in items])
+                       for k in items[0]}
+            b += 1
 
     if num_workers <= 0:
         return gen()
@@ -126,19 +139,20 @@ def batch_iterator(dataset: Any, batch_size: int, *, shuffle: bool = True,
 
 
 def _prefetched(gen_factory, *, num_workers: int, depth: int) -> Iterator:
-    """Run ``gen_factory()`` in a daemon thread feeding a bounded queue.
-
-    One producer thread suffices to hide batch-assembly latency behind device
-    compute (item synthesis is released-GIL numpy); ``num_workers`` scales the
-    queue depth the way torch's worker count scales its outstanding batches.
+    """Run ``num_workers`` producer threads, each materializing its
+    ``worker_id :: num_workers`` stripe of the batch sequence (the role of
+    torch's ``num_workers`` processes — threads suffice here because item
+    synthesis is released-GIL numpy). The consumer round-robins the
+    per-worker queues, so the delivered order is identical to the
+    single-producer stream regardless of thread scheduling.
     """
-    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth * num_workers))
     _END = object()
     stop = threading.Event()
+    queues = [queue.Queue(maxsize=max(1, depth)) for _ in range(num_workers)]
 
-    def _put(item) -> bool:
+    def _put(q: "queue.Queue", item) -> bool:
         # Bounded put that notices consumer shutdown, so an abandoned
-        # loop=True iterator doesn't leave the thread blocked forever
+        # loop=True iterator doesn't leave a thread blocked forever
         # holding a queue full of batches.
         while not stop.is_set():
             try:
@@ -148,24 +162,30 @@ def _prefetched(gen_factory, *, num_workers: int, depth: int) -> Iterator:
                 continue
         return False
 
-    def worker() -> None:
+    def worker(wid: int) -> None:
+        q = queues[wid]
         try:
-            for batch in gen_factory():
-                if not _put(batch):
+            for batch in gen_factory(worker_id=wid, stride=num_workers):
+                if not _put(q, batch):
                     return
-            _put(_END)
+            _put(q, _END)
         except BaseException as e:  # propagate to the consumer, don't die silent
-            _put(e)
+            _put(q, e)
 
-    threading.Thread(target=worker, daemon=True).start()
+    for wid in range(num_workers):
+        threading.Thread(target=worker, args=(wid,), daemon=True).start()
     try:
+        b = 0
         while True:
-            item = q.get()
+            item = queues[b % num_workers].get()
             if item is _END:
+                # Batch b doesn't exist -> no later batch does either (the
+                # stream is exhausted in order); drain nothing, just stop.
                 return
             if isinstance(item, BaseException):
                 raise item
             yield item
+            b += 1
     finally:
         stop.set()  # reached on GeneratorExit/close as well as normal end
 

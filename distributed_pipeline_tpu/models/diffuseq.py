@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..ops.xent import token_cross_entropy
 from .backbone import EMBED, TransformerBackbone, _dense_init
 from .diffusion import DiffusionSchedule
 
@@ -93,8 +94,13 @@ class DiffuSeqModel(nn.Module):
 
     def logits(self, x: jnp.ndarray) -> jnp.ndarray:
         """Rounding head: embedding-space points -> vocab logits via the tied
-        embedding matrix (f32 accumulation for a stable softmax)."""
-        return self.word_emb.attend(x.astype(jnp.float32))
+        embedding matrix. The matmul runs in the model compute dtype (bf16 on
+        TPU — MXU accumulates in f32 internally) so the [B, L, V] output
+        costs half the HBM traffic of an f32 head; softmax statistics are
+        taken in f32 downstream (ops/xent.py)."""
+        emb = self.word_emb.embedding
+        return jnp.einsum("...e,ve->...v", x.astype(self.dtype),
+                          emb.astype(self.dtype))
 
     def init_variables(self, ids: jnp.ndarray, t: jnp.ndarray,
                        pad_mask: jnp.ndarray) -> jnp.ndarray:
@@ -144,9 +150,7 @@ def diffuseq_losses(model: DiffuSeqModel, schedule: DiffusionSchedule,
     mse = _masked_mean(jnp.mean((x0_hat - x_start) ** 2, axis=-1), tgt_mask)
     tT = _masked_mean(schedule.mean_flat_tT(x_start), tgt_mask)
     logits = model.apply(params, x_start, method=DiffuSeqModel.logits)
-    nll_tok = -jax.nn.log_softmax(logits, axis=-1)
-    nll_tok = jnp.take_along_axis(nll_tok, ids[..., None], axis=-1)[..., 0]
-    decoder_nll = _masked_mean(nll_tok, tgt_mask)
+    decoder_nll = _masked_mean(token_cross_entropy(logits, ids), tgt_mask)
 
     loss = mse + tT + decoder_nll
     return {"loss": loss, "mse": mse, "tT": tT, "decoder_nll": decoder_nll}

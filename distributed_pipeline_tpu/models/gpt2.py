@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..ops.xent import token_cross_entropy
 from .backbone import EMBED, TransformerBackbone
 
 __all__ = ["GPT2Model", "gpt2_losses"]
@@ -51,7 +52,10 @@ class GPT2Model(nn.Module):
                                 self.remat, causal=True,
                                 attention_impl=self.attention_impl,
                                 name="backbone")(h, pad_mask)
-        return word_emb.attend(h.astype(jnp.float32))  # [B, L, V] f32 logits
+        # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the
+        # HBM traffic of f32; softmax stats go to f32 downstream (ops/xent.py).
+        return jnp.einsum("bld,vd->blv", h,
+                          word_emb.embedding.astype(self.dtype))
 
 
 def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
@@ -66,8 +70,7 @@ def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
 
     logits = model.apply(params, ids, pad_mask)[:, :-1]  # predict ids[:, 1:]
     targets = ids[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = token_cross_entropy(logits, targets)
     denom = jnp.maximum(loss_mask.sum(), 1.0)
     loss = (nll * loss_mask).sum() / denom
     return {"loss": loss, "nll": loss,

@@ -1,0 +1,37 @@
+"""Token-level cross-entropy without materializing log-probabilities.
+
+The straightforward ``-log_softmax(logits)[target]`` materializes a second
+[B, L, V] tensor (the log-probs) and runs a pathologically slow
+exp-reduce over it on TPU (profiled at ~94 ms/step for [256, 128, 8192] f32
+— 37% of the whole DiffuSeq-base train step). The identity
+
+    nll[b, l] = logsumexp(logits[b, l, :]) - logits[b, l, target]
+
+needs only two reductions over the logits: a max+exp-sum (fused by XLA into
+one pass with f32 accumulation even for bf16 logits) and a one-element
+gather. Nothing [B, L, V]-shaped is written back to HBM.
+
+Fills the loss-stub surface of the reference scaffold
+(``/root/reference/utils/trainer.py:23-31`` leaves ``compute_losses`` to the
+user); both concrete workloads (models/diffuseq.py, models/gpt2.py) route
+their vocab NLL through here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["token_cross_entropy"]
+
+
+def token_cross_entropy(logits: jnp.ndarray,
+                        targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-token ``-log p(target)`` for ``logits [..., V]``, ``targets [...]``
+    (int). Softmax statistics accumulate in f32 regardless of logits dtype;
+    the convert fuses into the reduction so bf16 logits are read once."""
+    l32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(l32, axis=-1)
+    tgt = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return lse - tgt.astype(jnp.float32)

@@ -43,6 +43,9 @@ def main(namespace: argparse.Namespace) -> None:
     dist.setup_dist()
     rank = dist.get_rank()
 
+    if args.debug_nans:  # SURVEY.md §5.2: debug flag -> jax NaN checker
+        jax.config.update("jax_debug_nans", True)
+
     # Run dir: model_checkpoints/Run_{dataset}_lr{lr}_seed{seed}_{ts}
     # (reference train.py:32-40), created by process 0.
     ckpt_path = args.checkpoint_path
@@ -55,11 +58,13 @@ def main(namespace: argparse.Namespace) -> None:
         os.makedirs(ckpt_path, exist_ok=True)
     dist.barrier("mkdir")
 
-    # log+csv sinks everywhere, stdout on the writer rank
-    # (reference train.py:43).
+    # log+csv sinks everywhere, stdout on the writer rank (reference
+    # train.py:43); metrics averaged across hosts at dump time (the
+    # reference's comm-averaged dumpkvs, logger.py:358-370).
     logger.configure(dir=ckpt_path,
                      format_strs=["log", "csv"] + (["stdout"] if rank == 0
-                                                   else []))
+                                                   else []),
+                     comm=logger.distributed_mean_comm())
     seed_all(args.seed)
 
     data = load_data_from_args("train", **args.dict())
@@ -81,6 +86,8 @@ def main(namespace: argparse.Namespace) -> None:
                        mode=os.environ["WANDB_MODE"])
             wandb.config.update(json.loads(args.to_json()),
                                 allow_val_change=True)
+            # Every dumpkvs now reaches wandb (reference logger.py:373-377).
+            logger.append_output_format("wandb")
         except Exception as e:
             logger.warn(f"wandb unavailable: {e}")
 
@@ -102,6 +109,7 @@ def main(namespace: argparse.Namespace) -> None:
         mesh=mesh,
         checkpoint_dir=ckpt_path,
         seed=args.seed,
+        profile_dir=args.profile_dir,
     )
     n_m = loop.n_params / 1e6
     logger.info(f"the parameter count is {loop.n_params} ({n_m:.1f}M)")

@@ -40,7 +40,8 @@ __all__ = [
     "log", "debug", "info", "warn", "error",
     "set_level", "get_dir", "record_tabular", "dump_tabular",
     "profile_kv", "profile", "configure", "reset", "scoped_configure",
-    "Logger", "get_current", "make_output_format",
+    "Logger", "get_current", "make_output_format", "append_output_format",
+    "distributed_mean_comm",
 ]
 
 DEBUG = 10
@@ -347,10 +348,18 @@ class Logger:
     CURRENT: Optional["Logger"] = None
     DEFAULT: Optional["Logger"] = None
 
+    # logkv_mean folds its raw-value buffer into a (sum, count) pair whenever
+    # it reaches this many entries, so huge log_intervals can't pin an
+    # unbounded list of device scalars (the fold only touches values logged
+    # >= MEAN_BUF_CAP steps ago — long since computed, so float() is a cheap
+    # copy, not a pipeline stall).
+    MEAN_BUF_CAP = 256
+
     def __init__(self, dir: Optional[str], output_formats: Sequence[KVWriter],
                  comm: Any = None):
         self.name2val: Dict[str, float] = defaultdict(float)
         self.name2mean: Dict[str, list] = {}
+        self.name2mean_folded: Dict[str, list] = {}  # key -> [sum, count]
         self.level = INFO
         self.dir = dir
         self.output_formats = list(output_formats)
@@ -366,14 +375,27 @@ class Logger:
         # step (the reference's grad-norm bug, trainer.py:265-271). Buffering
         # also never does array arithmetic, so values from different device
         # meshes can coexist until they become floats at dump.
-        self.name2mean.setdefault(key, []).append(val)
+        buf = self.name2mean.setdefault(key, [])
+        buf.append(val)
+        if len(buf) >= self.MEAN_BUF_CAP:
+            # Fold all but the newest entry: the newest may be an in-flight
+            # device scalar from the current step, and float() on it would
+            # stall the pipeline — the exact sync this buffering avoids.
+            folded = self.name2mean_folded.setdefault(key, [0.0, 0])
+            folded[0] += sum(float(v) for v in buf[:-1])
+            folded[1] += len(buf) - 1
+            del buf[:-1]
 
     def merged_kvs(self) -> Dict[str, Any]:
         """Overwrite-keys plus materialized means (device scalars become
         floats here — the single sync point)."""
         d = dict(self.name2val)
-        for key, buf in self.name2mean.items():
-            d[key] = sum(float(v) for v in buf) / len(buf)
+        for key in set(self.name2mean) | set(self.name2mean_folded):
+            s, n = self.name2mean_folded.get(key, (0.0, 0))
+            buf = self.name2mean.get(key, ())
+            total, count = s + sum(float(v) for v in buf), n + len(buf)
+            if count:
+                d[key] = total / count
         return d
 
     def dumpkvs(self) -> Dict[str, Any]:
@@ -388,6 +410,7 @@ class Logger:
                     fmt.writekvs(d)
         self.name2val.clear()
         self.name2mean.clear()
+        self.name2mean_folded.clear()
         return d
 
     # text API
@@ -418,6 +441,15 @@ def get_current() -> Logger:
     if Logger.CURRENT is None:
         _configure_default_logger()
     return Logger.CURRENT  # type: ignore[return-value]
+
+
+def append_output_format(fmt: str) -> None:
+    """Attach one more sink to the current logger — the hook that lets the
+    entry point add the wandb sink only after ``wandb.init`` succeeded
+    (the reference instead hardwires ``wandb.log`` into dumpkvs,
+    logger.py:373-377)."""
+    cur = get_current()
+    cur.output_formats.append(make_output_format(fmt, cur.dir or "."))
 
 
 def distributed_mean_comm():

@@ -108,6 +108,7 @@ class TrainLoop:
         mesh: Optional[Mesh] = None,
         checkpoint_dir: str = "",
         seed: int = 102,
+        profile_dir: str = "",
     ) -> None:
         self.workload = model
         self.data = data
@@ -130,6 +131,12 @@ class TrainLoop:
         self.weight_decay = weight_decay
         self.learning_steps = learning_steps
         self.checkpoint_dir = checkpoint_dir or logger.get_dir() or ""
+        # SURVEY.md §5.1 rebuild note: a first-class jax.profiler trace hook.
+        # A short window a few steps in (past compilation) is captured into
+        # profile_dir in TensorBoard format; 0-length dir disables.
+        self.profile_dir = profile_dir
+        self._profile_window = (3, 8)  # [start, stop) steps after loop entry
+        self._profiling = False
 
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         # global batch = per-host batch x hosts (reference trainer.py:89)
@@ -365,23 +372,47 @@ class TrainLoop:
                          round(tps / jax.device_count(), 1))
             logger.logkv("mfu", round(mfu(tps, self._flops_per_token), 4))
 
+    def _maybe_profile(self, loop_step: int) -> None:
+        """Start/stop the jax.profiler trace window (steps counted from loop
+        entry so resumed runs still capture a post-compilation window)."""
+        start, stop = self._profile_window
+        if loop_step == start and not self._profiling:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+            logger.info(f"profiler: tracing steps {start}..{stop} "
+                        f"-> {self.profile_dir}")
+        elif loop_step == stop and self._profiling:
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+            self._profiling = False
+
     def run_loop(self) -> None:
         """Interval-driven outer loop (reference run_loop trainer.py:175-196):
         log every ``log_interval``, eval every ``eval_interval``, save every
         ``save_interval``, final save on exit."""
-        while self.learning_steps <= 0 or self.step < self.learning_steps:
-            batch = next(self.data)
-            self.run_step(batch)
-            if self.step % self.log_interval == 0:
-                self._log_throughput()
-                logger.dumpkvs()
-            if self.eval_data is not None and self.step % self.eval_interval == 0:
-                self.forward_only(next(self.eval_data))
-                if jax.process_index() == 0:
-                    for cb in self.eval_callbacks:
-                        cb(self)
-            if self.step % self.save_interval == 0:
-                self.save()
+        loop_step = 0
+        try:
+            while self.learning_steps <= 0 or self.step < self.learning_steps:
+                if self.profile_dir:
+                    self._maybe_profile(loop_step)
+                batch = next(self.data)
+                self.run_step(batch)
+                loop_step += 1
+                if self.step % self.log_interval == 0:
+                    self._log_throughput()
+                    logger.dumpkvs()
+                if (self.eval_data is not None
+                        and self.step % self.eval_interval == 0):
+                    self.forward_only(next(self.eval_data))
+                    if jax.process_index() == 0:
+                        for cb in self.eval_callbacks:
+                            cb(self)
+                if self.step % self.save_interval == 0:
+                    self.save()
+        finally:
+            if self._profiling:  # run ended (or raised) inside the window:
+                jax.profiler.stop_trace()  # flush the trace either way
+                self._profiling = False
         if self.step % self.save_interval != 0:
             self.save()
 

@@ -106,3 +106,20 @@ def test_json_dump_is_loadable_config():
     # README.md:18-21 one-liner: default config dump must parse back.
     blob = TrainSettings().to_json()
     assert TrainSettings.model_validate(json.loads(blob)) == TrainSettings()
+
+
+def test_config_json_rejects_explicit_default_flag(tmp_path, monkeypatch):
+    """A flag explicitly set to its default value still conflicts with
+    --config_json (true mutual exclusivity, reference config/train.py:63-67)."""
+    import sys
+    from distributed_pipeline_tpu.config.train import TrainSettings
+
+    cfg = tmp_path / "c.json"
+    cfg.write_text(TrainSettings().to_json())
+    default_lr = TrainSettings().lr
+    argv = ["prog", "--lr", str(default_lr), "--config_json", str(cfg)]
+    monkeypatch.setattr(sys, "argv", argv)
+    parser = TrainSettings.to_argparse(add_json=True)
+    ns = parser.parse_args(argv[1:])
+    with pytest.raises(SystemExit):
+        TrainSettings.from_argparse(ns)

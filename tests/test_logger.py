@@ -128,3 +128,37 @@ def test_csv_resume_appends_consistently(tmp_path):
         logger.dumpkvs()
     lines = (tmp_path / "progress.csv").read_text().strip().splitlines()
     assert lines == ["a", "1", "2"]
+
+
+def test_logkv_mean_bounded_buffer(tmp_path):
+    """logkv_mean must not grow an unbounded list under huge log_intervals:
+    past MEAN_BUF_CAP entries the raw buffer folds into a (sum, count) pair,
+    and the dumped mean is still exact."""
+    n = logger.Logger.MEAN_BUF_CAP * 3 + 17
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        cur = logger.get_current()
+        for i in range(n):
+            logger.logkv_mean("m", float(i))
+            assert len(cur.name2mean["m"]) < logger.Logger.MEAN_BUF_CAP
+        d = logger.dumpkvs()
+    assert d["m"] == pytest.approx(sum(range(n)) / n)
+
+
+def test_wandb_sink_receives_dumped_metrics(tmp_path, monkeypatch):
+    """The wandb sink appended via append_output_format gets every dumpkvs
+    (the reference pushes dumps to wandb at logger.py:373-377)."""
+    import sys
+    import types
+
+    logged = []
+    fake = types.ModuleType("wandb")
+    fake.run = object()  # truthy: sink only logs when a run is active
+    fake.log = lambda d: logged.append(d)
+    monkeypatch.setitem(sys.modules, "wandb", fake)
+
+    with logger.scoped_configure(dir=str(tmp_path), format_strs=["json"]):
+        logger.append_output_format("wandb")
+        logger.logkv("loss", 0.5)
+        logger.logkv_mean("gn", 2.0)
+        logger.dumpkvs()
+    assert logged and logged[0]["loss"] == 0.5 and logged[0]["gn"] == 2.0

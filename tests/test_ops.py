@@ -94,3 +94,28 @@ def test_fully_masked_rows_give_zeros_not_nans():
     mask = jnp.zeros((2, 16), jnp.int32)  # everything padded
     out = flash_attention(q, k, v, mask, False, 16, 16)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_token_cross_entropy_matches_log_softmax():
+    """logsumexp-minus-target formulation == -log_softmax gather (the
+    rewrite exists purely to avoid materializing [B, L, V] log-probs)."""
+    from distributed_pipeline_tpu.ops.xent import token_cross_entropy
+
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (4, 16, 97)) * 3.0
+    targets = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 97)
+    got = token_cross_entropy(logits, targets)
+    ref = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                               targets[..., None], axis=-1)[..., 0]
+    assert jnp.allclose(got, ref, atol=1e-5)
+
+
+def test_token_cross_entropy_bf16_logits_f32_stats():
+    from distributed_pipeline_tpu.ops.xent import token_cross_entropy
+
+    logits = (jax.random.normal(jax.random.PRNGKey(0), (2, 8, 64)) * 2.0)
+    targets = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    got16 = token_cross_entropy(logits.astype(jnp.bfloat16), targets)
+    got32 = token_cross_entropy(logits, targets)
+    assert got16.dtype == jnp.float32
+    assert jnp.allclose(got16, got32, atol=0.05)
