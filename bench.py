@@ -31,7 +31,9 @@ def main() -> None:
     seq_len = 128
     # Per-chip batch 256 is the measured MFU sweet spot at base scale
     # (64/128/256/512 sweep on v5e); tiny on CPU so smoke runs finish fast.
-    batch = 256 * jax.device_count() if on_tpu else 8
+    # batch is PER HOST (trainer.py:89 semantics), so scale by the host's
+    # local chips, not the global device count.
+    batch = 256 * jax.local_device_count() if on_tpu else 8
     steps = 30 if on_tpu else 3
     wl = create_model_from_config(
         model_family="diffuseq", model_size="base", vocab_size=8192,

@@ -161,11 +161,14 @@ class ArgparseCompatibleBaseModel(BaseModel):
     @classmethod
     def from_argv(cls, argv: Optional[Sequence[str]] = None):
         parser = cls.to_argparse()
+        import sys
         ns = parser.parse_args(argv)
         # Record which argv this namespace came from, so downstream checks
         # (e.g. TrainSettings' --config_json exclusivity) inspect the actual
-        # parsed command line, not the hosting process's sys.argv.
-        ns._parsed_argv = list(argv) if argv is not None else None
+        # parsed command line, not the hosting process's unrelated sys.argv.
+        # (parse_args(None) consumed sys.argv itself, so there it IS the
+        # parsed command line.)
+        ns._parsed_argv = list(argv) if argv is not None else sys.argv[1:]
         return cls.from_argparse(ns)
 
     # ------------------------------------------------------------------ JSON

@@ -110,13 +110,14 @@ class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):
         if config_json:
             # True mutual exclusivity (reference's mutually-exclusive group,
             # config/train.py:63-67): a flag explicitly set to its default
-            # value still conflicts, so check the actual command line — the
-            # argv recorded by from_argv when one was given, else the
-            # process argv — with value-vs-default drift as the fallback for
-            # programmatic namespaces built without any command line.
+            # value still conflicts. Only an argv explicitly recorded on the
+            # namespace (by from_argv / parse_and_autorun) is inspected —
+            # never the hosting process's sys.argv, whose flags may belong
+            # to a wrapper script, not this parse. Programmatic namespaces
+            # without a recorded argv fall back to value-vs-default drift.
             import sys
             if parsed_argv == "absent" or parsed_argv is None:
-                argv = sys.argv[1:]
+                argv = []
             else:
                 argv = parsed_argv
             fields = set(cls.model_fields)

@@ -218,4 +218,9 @@ def parse_and_autorun(
         except ImportError:
             pass
 
-    return parser.parse_args(script_argv)
+    ns = parser.parse_args(script_argv)
+    # Record the exact argv this namespace came from so downstream checks
+    # (TrainSettings' --config_json exclusivity) never have to guess from the
+    # hosting process's sys.argv.
+    ns._parsed_argv = list(script_argv)
+    return ns

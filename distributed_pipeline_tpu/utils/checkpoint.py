@@ -11,6 +11,10 @@ TPU-native backend: Orbax (each name is an Orbax directory rather than a
 single-writer semantics, sharded-array save/restore that keeps each chip's
 shard on-chip (no host gather), and atomic finalization. Restore takes an
 abstract target tree so arrays come back with the requested shardings.
+
+Paths go through ``etils.epath``, so run dirs and resume paths may be remote
+URIs (``gs://...``) exactly like the reference's blobfile-backed reads
+(``/root/reference/basic_utils/dist_util.py:118-124``, SURVEY.md §5.4).
 """
 
 from __future__ import annotations
@@ -20,6 +24,7 @@ import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+from etils import epath
 
 import orbax.checkpoint as ocp
 
@@ -46,14 +51,17 @@ def parse_step_from_name(name: str) -> Optional[int]:
 
 
 def _scan(directory: str, prefix: str) -> List[Tuple[int, str]]:
-    if not directory or not os.path.isdir(directory):
+    if not directory:
+        return []
+    d = epath.Path(directory)
+    if not d.is_dir():
         return []
     out = []
-    for name in os.listdir(directory):
-        if name.startswith(prefix):
-            step = parse_step_from_name(name)
+    for child in d.iterdir():
+        if child.name.startswith(prefix):
+            step = parse_step_from_name(child.name)
             if step is not None:
-                out.append((step, os.path.join(directory, name)))
+                out.append((step, os.fspath(child)))
     return sorted(out)
 
 
@@ -65,13 +73,13 @@ def find_resume_checkpoint(directory: str) -> Optional[str]:
 
 
 def find_ema_checkpoint(directory: str, step: int, rate: str) -> Optional[str]:
-    path = os.path.join(directory, f"ema_{rate}_{step:06d}")
-    return path if os.path.isdir(path) else None
+    path = epath.Path(directory) / f"ema_{rate}_{step:06d}"
+    return os.fspath(path) if path.is_dir() else None
 
 
 def find_opt_checkpoint(directory: str, step: int) -> Optional[str]:
-    path = os.path.join(directory, f"opt_{step:06d}")
-    return path if os.path.isdir(path) else None
+    path = epath.Path(directory) / f"opt_{step:06d}"
+    return os.fspath(path) if path.is_dir() else None
 
 
 def latest_step(directory: str) -> int:
@@ -86,18 +94,17 @@ def save_checkpoint(directory: str, step: int, params: Any,
     ``directory``. Multi-host safe: every process must call this (Orbax
     coordinates the single-writer protocol); all processes block until the
     write is durable (the reference barriers after save, trainer.py:282)."""
-    directory = os.path.abspath(directory)
+    d = epath.Path(directory)
+    if not d.is_absolute() and "://" not in directory:
+        d = epath.Path(os.path.abspath(directory))  # orbax requires absolute
     if jax.process_index() == 0:
-        os.makedirs(directory, exist_ok=True)
+        d.mkdir(parents=True, exist_ok=True)
     ckptr = _checkpointer()
-    ckptr.save(os.path.join(directory, f"model_{step:06d}"), params,
-               force=True)
+    ckptr.save(d / f"model_{step:06d}", params, force=True)
     for rate, tree in (ema or {}).items():
-        ckptr.save(os.path.join(directory, f"ema_{rate}_{step:06d}"), tree,
-                   force=True)
+        ckptr.save(d / f"ema_{rate}_{step:06d}", tree, force=True)
     if opt_state is not None:
-        ckptr.save(os.path.join(directory, f"opt_{step:06d}"), opt_state,
-                   force=True)
+        ckptr.save(d / f"opt_{step:06d}", opt_state, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
 
@@ -123,14 +130,26 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
     Missing companions degrade to the restored params (the reference seeds
     EMA from params, trainer.py:110-113). Returns None when nothing to resume.
     """
-    model_path = explicit_model_path or find_resume_checkpoint(directory)
-    if not model_path or not os.path.isdir(model_path):
-        return None
+    if explicit_model_path:
+        # An explicitly requested resume must never silently fall through to
+        # fresh init (a typo'd path, or a reference-style model_NNNNNN.pt
+        # FILE where an Orbax checkpoint DIRECTORY is expected, would
+        # otherwise restart training from scratch unnoticed; the reference
+        # asserts on malformed names, trainer.py:319-327).
+        if not epath.Path(explicit_model_path).is_dir():
+            raise FileNotFoundError(
+                f"resume_checkpoint={explicit_model_path!r} is not an Orbax "
+                f"checkpoint directory (expected .../model_{{step:06d}}/)")
+        model_path = explicit_model_path
+    else:
+        model_path = find_resume_checkpoint(directory)
+        if not model_path:
+            return None
     step = parse_step_from_name(model_path) or 0
     params = restore_checkpoint(model_path, abstract_params)
     out: Dict[str, Any] = {"step": step, "params": params, "ema": {},
                            "opt_state": None}
-    directory = os.path.dirname(model_path)
+    directory = os.fspath(epath.Path(model_path).parent)
     for rate in ema_rates:
         p = find_ema_checkpoint(directory, step, rate)
         if p:

@@ -108,18 +108,34 @@ def test_json_dump_is_loadable_config():
     assert TrainSettings.model_validate(json.loads(blob)) == TrainSettings()
 
 
-def test_config_json_rejects_explicit_default_flag(tmp_path, monkeypatch):
+def test_config_json_rejects_explicit_default_flag(tmp_path):
     """A flag explicitly set to its default value still conflicts with
-    --config_json (true mutual exclusivity, reference config/train.py:63-67)."""
-    import sys
+    --config_json (true mutual exclusivity, reference config/train.py:63-67).
+    The parsed argv is carried on the namespace (as the launcher and
+    from_argv record it), never sniffed from the process's sys.argv."""
     from distributed_pipeline_tpu.config.train import TrainSettings
 
     cfg = tmp_path / "c.json"
     cfg.write_text(TrainSettings().to_json())
     default_lr = TrainSettings().lr
-    argv = ["prog", "--lr", str(default_lr), "--config_json", str(cfg)]
-    monkeypatch.setattr(sys, "argv", argv)
+    argv = ["--lr", str(default_lr), "--config_json", str(cfg)]
     parser = TrainSettings.to_argparse(add_json=True)
-    ns = parser.parse_args(argv[1:])
+    ns = parser.parse_args(argv)
+    ns._parsed_argv = argv  # what parse_and_autorun/from_argv attach
     with pytest.raises(SystemExit):
         TrainSettings.from_argparse(ns)
+
+
+def test_config_json_ignores_hosting_process_argv(tmp_path, monkeypatch):
+    """A programmatic parse (no recorded argv) must not abort on flags that
+    belong to the hosting process's command line."""
+    import sys
+    from distributed_pipeline_tpu.config.train import TrainSettings
+
+    cfg = tmp_path / "c.json"
+    cfg.write_text(TrainSettings().to_json())
+    monkeypatch.setattr(sys, "argv", ["driver.py", "--seed", "7"])
+    parser = TrainSettings.to_argparse(add_json=True)
+    ns = parser.parse_args(["--config_json", str(cfg)])
+    settings = TrainSettings.from_argparse(ns)  # must not raise
+    assert settings.seed == TrainSettings().seed

@@ -247,3 +247,34 @@ def test_resume_across_mesh_change(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(loop.state.params),
                     jax.tree_util.tree_leaves(loop2.state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_explicit_resume_path_invalid_raises(tmp_path):
+    """ADVICE r1 (medium): a typo'd --resume_checkpoint must fail loudly,
+    never silently restart from scratch."""
+    from distributed_pipeline_tpu.utils import checkpoint as ckpt_lib
+
+    with pytest.raises(FileNotFoundError):
+        ckpt_lib.restore_resume_state(
+            str(tmp_path), abstract_params={},
+            explicit_model_path=str(tmp_path / "model_000123.pt"))
+
+
+def test_checkpoint_discovery_through_epath(tmp_path):
+    """Discovery/save/resume drive through etils.epath so remote URIs
+    (gs://...) take the same code path as local dirs (SURVEY.md §5.4)."""
+    from etils import epath
+
+    from distributed_pipeline_tpu.utils import checkpoint as ckpt_lib
+
+    d = epath.Path(str(tmp_path))  # epath-style handle over a local dir
+    params = {"w": jnp.arange(4, dtype=jnp.float32)}
+    ckpt_lib.save_checkpoint(os.fspath(d), 7, params)
+    found = ckpt_lib.find_resume_checkpoint(os.fspath(d))
+    assert found is not None and found.endswith("model_000007")
+    abstract = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    out = ckpt_lib.restore_resume_state(os.fspath(d), abstract_params=abstract)
+    assert out is not None and out["step"] == 7
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(4, dtype=np.float32))
