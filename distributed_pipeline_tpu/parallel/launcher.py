@@ -57,6 +57,14 @@ def create_distributed_parser() -> argparse.ArgumentParser:
                         "for torchrun --standalone)")
     p.add_argument("--devices_per_proc", type=int, default=2,
                    help="fake CPU devices per spawned local worker")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="respawn the worker ring this many times after a "
+                        "failure; checkpoint auto-resume continues the run "
+                        "(reference dist_run.py:123-129)")
+    p.add_argument("--monitor_interval", type=float, default=0.2,
+                   help="seconds between worker liveness polls (reference "
+                        "dist_run.py:130-136; default is snappier than "
+                        "torchrun's 5s — these are local dev workers)")
     return p
 
 
@@ -74,7 +82,8 @@ def parse_distributed_args(
     # reference's usage/epilog injection (dist_run.py:227-247).
     epilog = ("launcher options: --distributed "
               "[--coordinator_address H:P] [--num_processes N] "
-              "[--process_id I] [--nprocs N] [--devices_per_proc K]")
+              "[--process_id I] [--nprocs N] [--devices_per_proc K] "
+              "[--max_restarts R] [--monitor_interval S]")
     if epilog not in (parser.epilog or ""):
         parser.epilog = ((parser.epilog or "") + "\n\n" + epilog)
     return dist_ns, rest
@@ -92,17 +101,19 @@ def get_main_modname() -> Optional[str]:
     return None
 
 
-def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
-                            nprocs: int, devices_per_proc: int = 2) -> int:
-    """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
-    over loopback (dev-mode multi-process, one CPU backend per worker).
+def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
+                     monitor_interval: float) -> int:
+    """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
-    Reference equivalent: in-process ``torch.distributed.run.run``
-    (dist_run.py:13-54). Returns the max worker exit code.
+    A worker that dies (e.g. on an import error before joining the ring)
+    would leave its siblings blocked in jax.distributed.initialize forever —
+    terminate them instead (torchrun's elastic agent behavior). Returns the
+    max worker exit code.
     """
+    import time
+
     port = find_free_port()
     coord = f"127.0.0.1:{port}"
-    cmd_base = [sys.executable, "-m", modname, *script_argv]
     print(f"[launcher] spawning {nprocs} local workers, coordinator {coord}")
     print(f"[launcher] worker cmd: {' '.join(cmd_base)}")  # cmdline echo,
     # like reference dist_run.py:36-44
@@ -124,10 +135,6 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
             + f"--xla_force_host_platform_device_count={devices_per_proc}",
         })
         procs.append(subprocess.Popen(cmd_base, env=env))
-    # Fail fast like torchrun's elastic agent: a worker that dies (e.g. on an
-    # import error before joining the ring) would leave its siblings blocked
-    # in jax.distributed.initialize forever — terminate them instead.
-    import time
     codes: List[Optional[int]] = [None] * len(procs)
     try:
         while any(c is None for c in codes):
@@ -149,12 +156,42 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             p.kill()
                             codes[i] = p.wait()
                 break
-            time.sleep(0.2)
+            time.sleep(max(monitor_interval, 0.02))
     except KeyboardInterrupt:
         for p in procs:
             p.terminate()
         raise
-    return max((c for c in codes if c is not None), default=0)
+    # Any nonzero code fails the attempt — max() would mask a signal-killed
+    # worker (negative returncode) behind a sibling's clean 0.
+    return next((c for c in codes if c not in (None, 0)), 0)
+
+
+def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
+                            nprocs: int, devices_per_proc: int = 2,
+                            max_restarts: int = 0,
+                            monitor_interval: float = 0.2) -> int:
+    """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
+    over loopback (dev-mode multi-process, one CPU backend per worker).
+
+    Restart supervision (reference torch.elastic via ``--max_restarts``,
+    dist_run.py:123-136 + SURVEY.md §5.3 recovery story): when the ring dies
+    and restarts remain, the whole ring is respawned on a fresh coordinator
+    port; workers rediscover the newest checkpoint in their run dir and
+    resume (utils/checkpoint.py auto-resume contract).
+
+    Reference equivalent: in-process ``torch.distributed.run.run``
+    (dist_run.py:13-54). Returns the final attempt's max worker exit code.
+    """
+    cmd_base = [sys.executable, "-m", modname, *script_argv]
+    attempt = 0
+    while True:
+        code = _run_worker_ring(cmd_base, nprocs, devices_per_proc,
+                                monitor_interval)
+        if code == 0 or attempt >= max_restarts:
+            return code
+        attempt += 1
+        print(f"[launcher] ring failed (rc={code}); "
+              f"restart {attempt}/{max_restarts}")
 
 
 def parse_and_autorun(
@@ -179,7 +216,9 @@ def parse_and_autorun(
             raise RuntimeError(
                 "--nprocs relaunch requires running as a module (python -m ...)")
         code = run_argv_as_distributed(modname, script_argv, dist_ns.nprocs,
-                                       dist_ns.devices_per_proc)
+                                       dist_ns.devices_per_proc,
+                                       max_restarts=dist_ns.max_restarts,
+                                       monitor_interval=dist_ns.monitor_interval)
         sys.exit(code)
 
     if dist_ns.distributed:

@@ -9,4 +9,6 @@ par.setup_dist()
 import jax  # noqa: E402  (after setup_dist, like a real worker)
 
 assert jax.process_count() == 2, jax.process_count()
-print("RANK", jax.process_index(), "OK")
+# One atomic write: multi-arg print interleaves between workers sharing the
+# parent's pipe ("RANKRANK 0 OK\n 1 OK").
+print(f"RANK {jax.process_index()} OK", flush=True)

@@ -1,0 +1,74 @@
+"""Fixture worker: a REAL multi-process training run over a spawned
+jax.distributed ring (run via ``--distributed --nprocs 2``).
+
+Exercises the full multi-host path end-to-end: per-host data sharding,
+``make_array_from_process_local_data`` batch assembly, the jitted train step
+over a multi-process mesh, cross-process metric averaging, and multi-host
+Orbax save/auto-resume.
+
+``--die_at_step K``: process 1 SIGKILLs itself ONCE at step K (a marker file
+in the run dir makes the restarted attempt survive) — the fault-injection
+half of the launcher's ``--max_restarts`` supervision test.
+"""
+
+import argparse
+import json
+import os
+import signal
+
+import distributed_pipeline_tpu.parallel as par
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--ckpt_dir", required=True)
+parser.add_argument("--steps", type=int, default=6)
+parser.add_argument("--save_interval", type=int, default=2)
+parser.add_argument("--die_at_step", type=int, default=0)
+ns = par.parse_and_autorun(parser)
+par.setup_dist()
+
+import jax  # noqa: E402  (after setup_dist, like a real worker)
+
+from distributed_pipeline_tpu.data import load_data_from_args  # noqa: E402
+from distributed_pipeline_tpu.models import create_model_from_config  # noqa: E402
+from distributed_pipeline_tpu.parallel import make_mesh  # noqa: E402
+from distributed_pipeline_tpu.utils import logger  # noqa: E402
+from distributed_pipeline_tpu.utils.trainer import TrainLoop  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+rank = jax.process_index()
+
+logger.configure(dir=ns.ckpt_dir, format_strs=["log"],
+                 comm=logger.distributed_mean_comm())
+
+batch = 4  # per host -> global 8 (reference trainer.py:89 semantics)
+wl = create_model_from_config(
+    model_family="diffuseq", vocab_size=64, seq_len=16, hidden_size=32,
+    num_layers=1, num_heads=2, diffusion_steps=50, dtype="float32")
+data = load_data_from_args("train", batch_size=batch, seq_len=16,
+                           vocab_size=64, seed=0)
+loop = TrainLoop(model=wl, data=data, batch_size=batch, microbatch=2,
+                 lr=1e-3, ema_rate="0.9", learning_steps=ns.steps,
+                 log_interval=10 ** 6, save_interval=ns.save_interval,
+                 mesh=make_mesh(dp=-1), checkpoint_dir=ns.ckpt_dir, seed=0)
+assert loop.global_batch == batch * jax.process_count(), loop.global_batch
+
+marker = os.path.join(ns.ckpt_dir, "died.marker")
+losses = []
+while loop.step < ns.steps:
+    if (ns.die_at_step and rank == 1 and loop.step == ns.die_at_step
+            and not os.path.exists(marker)):
+        with open(marker, "w") as f:
+            f.write("x")
+        os.kill(os.getpid(), signal.SIGKILL)
+    metrics = loop.run_step(next(loop.data))
+    losses.append(float(metrics["loss"]))
+    if loop.step % loop.save_interval == 0:
+        loop.save()
+
+assert all(l == l for l in losses), f"NaN loss: {losses}"
+if rank == 0:
+    with open(os.path.join(ns.ckpt_dir, "trace.json"), "w") as f:
+        json.dump({"first_step": ns.steps - len(losses) + 1,
+                   "losses": losses}, f)
+print(f"TRAINRANK {rank} OK steps={len(losses)} "
+      f"first_loss={losses[0]:.4f} last_loss={losses[-1]:.4f}")

@@ -103,3 +103,52 @@ def test_launcher_spawns_real_multiprocess_ring():
     )
     assert out.returncode == 0, out.stderr
     assert "RANK 0 OK" in out.stdout and "RANK 1 OK" in out.stdout
+
+
+def _run_train_child(tmp_path, extra, timeout=420):
+    import os
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "tests._train_child",
+         "--distributed", "--nprocs", "2",
+         "--ckpt_dir", str(tmp_path), *extra],
+        capture_output=True, text=True, timeout=timeout, cwd=repo_root,
+    )
+
+
+def test_multiprocess_end_to_end_training(tmp_path):
+    """VERDICT r1 #4: real TrainLoop steps over a 2-process loopback ring —
+    per-host batches assembled into global arrays
+    (make_array_from_process_local_data), global_batch = local x hosts,
+    multi-host Orbax save."""
+    import json
+    import os
+
+    out = _run_train_child(tmp_path, ["--steps", "6", "--save_interval", "3"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "TRAINRANK 0 OK" in out.stdout and "TRAINRANK 1 OK" in out.stdout
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    assert trace["first_step"] == 1 and len(trace["losses"]) == 6
+    # Training must actually learn (not just run): loss drops over 6 steps.
+    assert trace["losses"][-1] < trace["losses"][0]
+    assert (tmp_path / "model_000006").is_dir()  # multi-host Orbax save
+
+
+def test_launcher_restart_supervision_resumes_past_checkpoint(tmp_path):
+    """VERDICT r1 #6: SIGKILL a worker mid-run; with --max_restarts the
+    launcher respawns the ring and checkpoint auto-resume continues the job
+    past its last checkpoint step (reference torch.elastic --max_restarts,
+    dist_run.py:123-136)."""
+    import json
+
+    out = _run_train_child(
+        tmp_path,
+        ["--steps", "6", "--save_interval", "2", "--die_at_step", "3",
+         "--max_restarts", "1"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "restart 1/1" in out.stdout
+    assert (tmp_path / "died.marker").exists()
+    # The restarted attempt resumed from the step-2 checkpoint, not scratch.
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    assert trace["first_step"] == 3, trace
+    assert (tmp_path / "model_000006").is_dir()
