@@ -85,8 +85,12 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         if mesh is not None and mesh.shape.get("sequence", 1) > 1:
             impl = "ring"  # sequence-parallel mesh: attention must ring
         else:
+            # Flash (fwd + blocked bwd) wins from ~1k context up: measured
+            # even with XLA at L=2048 and ~2x faster by L=8192 on v5e, with
+            # O(L) instead of O(L^2) HBM in BOTH directions. Below that the
+            # dense XLA path is faster and the [L, L] logits are small.
             on_tpu = jax.default_backend() == "tpu"
-            impl = "pallas" if (on_tpu and q.shape[-2] >= 512) else "xla"
+            impl = "pallas" if (on_tpu and q.shape[-2] >= 1024) else "xla"
     if impl == "xla":
         return _xla_attention(q, k, v, pad_mask, causal)
     if impl == "pallas":

@@ -248,7 +248,14 @@ class TrainLoop:
             """lax.scan over the [n_micro, ...] leading axis, accumulating
             loss metrics (and grads) — the reference's inner microbatch loop
             + DDP no_sync trick (trainer.py:230-235) with the single psum
-            emitted by XLA at the end."""
+            emitted by XLA at the end.
+
+            Deliberate deviation from the reference: microbatch grads are
+            AVERAGED (scale 1/n_micro), where the reference sums unscaled
+            ``loss.backward()`` calls — so the effective gradient here is
+            independent of the accumulation factor and the baseline lr must
+            NOT be rescaled when comparing loss curves with microbatching
+            (codified by test_grad_accumulation_equivalence)."""
             def loss_fn(p, mb, r):
                 d = wl.compute_losses(p, mb, r)
                 return d["loss"], d

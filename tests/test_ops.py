@@ -119,3 +119,59 @@ def test_token_cross_entropy_bf16_logits_f32_stats():
     got32 = token_cross_entropy(logits, targets)
     assert got16.dtype == jnp.float32
     assert jnp.allclose(got16, got32, atol=0.05)
+
+
+def test_flash_backward_parity_long_sequence():
+    """VERDICT r1 #7: blocked pallas dq/dk/dv (no XLA recompute) must match
+    the XLA gradients at long L — the training-memory O(L) claim."""
+    q, k, v = _rand_qkv(11, B=1, H=2, L=1024, Dh=32)
+    mask = jnp.ones((1, 1024), jnp.int32).at[:, 900:].set(0)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, mask) ** 2).sum()
+
+    def loss_xla(q, k, v):
+        return (_xla_attention(q, k, v, mask, False) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        assert jnp.allclose(a, b, atol=2e-3), float(jnp.abs(a - b).max())
+
+
+def test_flash_backward_parity_causal():
+    q, k, v = _rand_qkv(13, B=2, H=2, L=256, Dh=32)
+    gf = jax.grad(lambda *a: (flash_attention(*a, None, True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lambda *a: (_xla_attention(*a, None, True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        assert jnp.allclose(a, b, atol=2e-3), float(jnp.abs(a - b).max())
+
+
+def test_flash_odd_length_direct_call():
+    """ADVICE r1: an explicit odd L must round blocks to the 8-row sublane
+    tile, not emit a 100-row block."""
+    q, k, v = _rand_qkv(17, B=1, H=1, L=100, Dh=32)
+    out = flash_attention(q, k, v)
+    ref = _xla_attention(q, k, v, None, False)
+    assert jnp.allclose(out, ref, atol=2e-3)
+    g = jax.grad(lambda *a: (flash_attention(*a) ** 2).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lambda *a: (_xla_attention(*a, None, False) ** 2).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gx):
+        assert jnp.allclose(a, b, atol=2e-3)
+
+
+def test_flash_fully_masked_rows_zero_grads():
+    """Fully-masked rows emit exact zeros forward (not a softmax over raw
+    scores) and contribute zero gradient."""
+    q, k, v = _rand_qkv(19, B=1, H=1, L=64, Dh=32)
+    mask = jnp.zeros((1, 64), jnp.int32)  # every key masked
+    out = flash_attention(q, k, v, mask)
+    assert jnp.all(out == 0.0)
+    g = jax.grad(lambda *a: (flash_attention(*a, mask) ** 2).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert jnp.all(jnp.isfinite(a)) and jnp.all(a == 0.0)
