@@ -91,9 +91,13 @@ class ArgparseCompatibleBaseModel(BaseModel):
         become ``choices`` (base.py:44-51); bools get lenient string coercion.
         """
         if parser is None:
+            # allow_abbrev=False: prefix-abbreviated flags (--log_int) would
+            # dodge the --config_json mutual-exclusivity scan, which matches
+            # argv tokens against exact field names (config/train.py).
             parser = argparse.ArgumentParser(
                 description=cls.__doc__,
                 formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+                allow_abbrev=False,
             )
         target = group if group is not None else parser
         for name, field in cls.model_fields.items():

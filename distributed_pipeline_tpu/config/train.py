@@ -42,6 +42,11 @@ class GeneralSettings(S):
     debug_nans: bool = _(False, "enable jax_debug_nans: fail loudly at the op "
                                 "that first produces a NaN (debug runs only; "
                                 "disables async dispatch)")
+    eval_decode: bool = _(False, "decode a validation batch at every eval "
+                                 "interval and log decode_acc (DiffuSeq "
+                                 "reverse diffusion / GPT-2 greedy)")
+    eval_decode_sample_steps: int = _(32, "reverse-diffusion steps for "
+                                         "eval decoding (diffuseq only)")
     profile_dir: str = _("", "capture a jax.profiler trace of a few steps "
                              "into this directory (TensorBoard format)")
 

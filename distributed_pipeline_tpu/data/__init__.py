@@ -212,6 +212,7 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
                         *, dataset: str = "synthetic-seq2seq",
                         seq_len: int = 128, vocab_size: int = 8192,
                         seed: int = 0, data_loader_workers: int = 0,
+                        host_sharded: bool = True,
                         **_unused: Any) -> Iterator[Dict[str, np.ndarray]]:
     """The reference's loader entry point (``data/__init__.py:1-27``), with
     identical call semantics: ``deterministic`` disables shuffling (used for
@@ -219,7 +220,10 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
     infinitely, ``num_loader_proc`` enables background prefetch
     (``data_loader_workers``, the ``DataSettings`` field name, is an accepted
     alias so ``load_data_from_args(**settings.dict())`` wires prefetch).
-    ``batch_size`` is per host; global batch = ``batch_size * process_count``."""
+    ``batch_size`` is per host; global batch = ``batch_size * process_count``.
+    ``host_sharded=False`` gives every host the SAME stream (required when a
+    batch feeds a collective computation as a replicated array — e.g. the
+    eval-decode callback — where per-host divergence would be silent UB)."""
     import jax
 
     ds = _build_dataset(dataset, data_dir, split, seq_len=seq_len,
@@ -229,7 +233,7 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
         shuffle=not deterministic,
         seed=seed,
         loop=loop,
-        process_index=jax.process_index(),
-        process_count=jax.process_count(),
+        process_index=jax.process_index() if host_sharded else 0,
+        process_count=jax.process_count() if host_sharded else 1,
         num_workers=max(num_loader_proc, data_loader_workers),
     )

@@ -43,7 +43,7 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse"]
 
 NEG_INF = -1e9
 LANES = 128  # TPU lane width: last-dim tiles and stat buffers align to this
@@ -294,9 +294,16 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, H, Lq, D)[:, :, :L, :Dh], lse[:, :, 0]
 
 
-def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k):
+def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k,
+                    g_lse=None):
     """Blocked dq/dk/dv — probability blocks recomputed from (q, k, lse);
-    nothing [L, L]-shaped touches HBM (FlashAttention-2 backward)."""
+    nothing [L, L]-shaped touches HBM (FlashAttention-2 backward).
+
+    ``g_lse`` (optional, [bh, Lq] f32) is the cotangent of the emitted LSE
+    (ring attention differentiates through its cross-hop fold weights):
+    d lse_i/d s_ij = p_ij, so the contribution folds into the existing
+    softmax-jacobian term as ds = p*(dp - (delta - g_lse)) — the kernels
+    run unchanged on an adjusted delta."""
     B, H, L, Dh = q.shape
     sm_scale = Dh ** -0.5
     block_q, block_k = _block_sizes(L, block_q, block_k)
@@ -308,6 +315,8 @@ def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k):
     # are expanded to lane-replicated [*, Lq, LANES] tiles here, just-in-time
     # for the kernels (the compact [bh, Lq] form is what persists).
     delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bh, Lq, LANES))
     lse = jnp.broadcast_to(lse[..., None], (bh, Lq, LANES))
 
@@ -391,3 +400,43 @@ def _bwd(causal, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        pad_mask: Optional[jnp.ndarray] = None,
+                        causal: bool = False,
+                        block_q: int = 512, block_k: int = 512):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ([B, H, L] f32). Ring attention (parallel/ring.py) composes
+    per-hop flash results with exactly-softmax cross-hop folding using the
+    LSE; its gradient flows through BOTH outputs (the fold weights are
+    functions of the LSE), which the VJP folds into the delta term.
+
+    Fully-masked query rows emit out == 0 and lse == NEG_INF-ish, which the
+    ring fold maps to weight 0 — so masked hops contribute nothing."""
+    B, H, L, _ = q.shape
+    out, lse = _flash_forward(q, k, v, pad_mask, causal, block_q, block_k)
+    return out, lse[:, :L].reshape(B, H, L)
+
+
+def _fwd_lse(q, k, v, pad_mask, causal, block_q, block_k):
+    B, H, L, _ = q.shape
+    out, lse = _flash_forward(q, k, v, pad_mask, causal, block_q, block_k)
+    return (out, lse[:, :L].reshape(B, H, L)), (q, k, v, pad_mask, out, lse)
+
+
+def _bwd_lse(causal, block_q, block_k, res, cotangents):
+    q, k, v, pad_mask, o, lse = res
+    g_out, g_lse = cotangents
+    B, H, L, _ = q.shape
+    Lq = lse.shape[1]  # padded query length the kernels iterate over
+    g_lse_p = jnp.zeros((B * H, Lq), jnp.float32)
+    g_lse_p = g_lse_p.at[:, :L].set(
+        g_lse.reshape(B * H, L).astype(jnp.float32))
+    dq, dk, dv = _flash_backward(q, k, v, pad_mask, o, lse, g_out, causal,
+                                 block_q, block_k, g_lse=g_lse_p)
+    return dq, dk, dv, None
+
+
+flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)

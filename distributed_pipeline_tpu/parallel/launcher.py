@@ -102,7 +102,8 @@ def get_main_modname() -> Optional[str]:
 
 
 def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
-                     monitor_interval: float) -> int:
+                     monitor_interval: float,
+                     run_timestamp: Optional[str] = None) -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -120,6 +121,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     procs = []
     for i in range(nprocs):
         env = dict(os.environ)
+        if run_timestamp:
+            env["DPT_RUN_TIMESTAMP"] = run_timestamp
         env.update({
             AUTORUN_ENV_FLAG: "1",
             "JAX_COORDINATOR_ADDRESS": coord,
@@ -183,10 +186,22 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     (dist_run.py:13-54). Returns the final attempt's max worker exit code.
     """
     cmd_base = [sys.executable, "-m", modname, *script_argv]
+    # Pin the run timestamp ONCE for all attempts: run/train.py derives its
+    # auto-generated run dir from DPT_RUN_TIMESTAMP when set, so a respawned
+    # ring lands in the SAME directory and checkpoint auto-resume actually
+    # resumes (without this, each attempt would mint a fresh timestamped dir
+    # and silently restart from step 0). Also removes the latent race where
+    # workers spawned across a second boundary disagree on the dir name.
+    # Passed to the WORKERS' env only — mutating this process's environ
+    # would leak the timestamp into a second launch from the same process,
+    # silently resuming run 2 from run 1's checkpoints.
+    import time
+    run_timestamp = os.environ.get("DPT_RUN_TIMESTAMP") or time.strftime(
+        "%Y%m%d-%H%M%S")
     attempt = 0
     while True:
         code = _run_worker_ring(cmd_base, nprocs, devices_per_proc,
-                                monitor_interval)
+                                monitor_interval, run_timestamp)
         if code == 0 or attempt >= max_restarts:
             return code
         attempt += 1
@@ -240,8 +255,24 @@ def parse_and_autorun(
         os.environ[AUTORUN_ENV_FLAG] = "1"
         is_available.cache = True  # type: ignore[attr-defined]
         if dist_ns.num_processes and dist_ns.num_processes > 1:
+            # All hosts must agree on the auto-generated run dir; pin the
+            # timestamp here and ship it in the echoed per-host command so
+            # host clocks (and re-executions after a failure) can't diverge.
+            # The COORDINATOR (process 0 / unset) mints a FRESH timestamp
+            # every launch — inheriting a stale one from a previous run in
+            # this environment would silently resume that run's checkpoints.
+            # Workers (process_id > 0) inherit the value the coordinator's
+            # echoed command gave them.
+            import time
+            if dist_ns.process_id in (None, 0):
+                os.environ["DPT_RUN_TIMESTAMP"] = time.strftime(
+                    "%Y%m%d-%H%M%S")
+            else:
+                os.environ.setdefault("DPT_RUN_TIMESTAMP",
+                                      time.strftime("%Y%m%d-%H%M%S"))
             modname = get_main_modname() or "<module>"
             print(f"[launcher] per-host command (run with --process_id i): "
+                  f"DPT_RUN_TIMESTAMP={os.environ['DPT_RUN_TIMESTAMP']} "
                   f"python -m {modname} --distributed "
                   f"--coordinator_address {os.environ['JAX_COORDINATOR_ADDRESS']} "
                   f"--num_processes {dist_ns.num_processes} "

@@ -6,19 +6,26 @@ first-class: activations shard over sequence ([B, H, L/n, Dh] per chip) and
 attention runs as a ring — each chip holds its query shard, while key/value
 shards rotate around the ``sequence`` axis via ``ppermute`` (ICI
 neighbor-to-neighbor, the topology TPU ICI is best at). Per hop, a chip
-folds the visiting K/V block into a running online-softmax state
-(FlashAttention-style max/normalizer/accumulator), so
+runs the Pallas flash kernel (ops/flash_attention.py) on (its query shard x
+the visiting K/V block) and folds the block's normalized output into a
+running online-softmax state using the kernel's log-sum-exp, so
 
-* memory per chip stays O(L/n) for activations and O((L/n)^2) for scores;
+* memory per chip stays O(L/n): the flash kernel streams the block through
+  VMEM (never materializing the [L/n, L/n] score matrix the dense fallback
+  would), and the fold state is O(L/n);
 * compute and communication overlap naturally (the next block can be in
   flight while the current one multiplies);
 * the math is EXACTLY softmax attention — tests assert parity with the
-  dense XLA path, gradients included (``ppermute`` is differentiable).
+  dense XLA path, gradients included (``ppermute`` and the flash kernel's
+  LSE output are both differentiable).
 
-Causal masking uses global offsets derived from each block's source shard
-index, so rotated blocks mask correctly. Compute stays uniform across hops
-(fully-masked hops are masked, not skipped) — SPMD programs must not branch
-per device.
+Causal masking: the diagonal hop (block from this chip's own shard) runs the
+kernel with its causal flag; blocks from earlier shards attend fully; blocks
+from later shards contribute nothing (zero output, -inf LSE — weight 0 in
+the fold). The three cases select via ``lax.switch`` on the traced source
+index — safe per-device branching, because every branch is chip-local
+compute (no collectives inside), so no SPMD rendezvous can diverge; the
+``ppermute`` rotating the carry stays unconditional every hop.
 
 Usage: inside ``shard_map`` (models get there via
 ``ops.attention.dot_product_attention(impl="ring")`` which wraps this in a
@@ -45,12 +52,14 @@ def current_mesh():
     return None if m.empty else m
 
 
-def _block_attn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                kmask: Optional[jnp.ndarray], causal: bool,
-                q_off: jnp.ndarray, k_off: jnp.ndarray,
-                sm_scale: float) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One (q-shard x kv-block) attention piece -> (exp-weighted values,
-    row max, row normalizer), f32. Shapes: q [B,H,Lq,D], k/v [B,H,Lk,D]."""
+def _dense_block_attn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      kmask: Optional[jnp.ndarray], causal: bool,
+                      q_off: jnp.ndarray, k_off: jnp.ndarray,
+                      sm_scale: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense einsum fallback for one (q-shard x kv-block) piece ->
+    (normalized out, lse), f32 stats. Shapes: q [B,H,Lq,D], k/v [B,H,Lk,D].
+    Materializes the [Lq, Lk] score block — kept only as the reference
+    implementation the flash path is tested against."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     if kmask is not None:
@@ -60,20 +69,58 @@ def _block_attn(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
         cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
         s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)                    # [B,H,Lq,1]
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
+    live = s > NEG_INF / 2
+    m = jnp.max(s, axis=-1)                                   # [B,H,Lq]
+    p = jnp.where(live, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)                                   # [B,H,Lq]
     pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
-    return pv, m, l
+    out = pv / jnp.maximum(l, 1e-20)[..., None]
+    lse = m + jnp.log(jnp.maximum(l, 1e-20))
+    return out, lse
+
+
+def _flash_block_attn(q, k, v, kmask, causal, my, src,
+                      block_q: int, block_k: int):
+    """One hop through the Pallas flash kernel -> (normalized out, lse).
+
+    ``my``/``src`` are traced shard indices; under causal attention they
+    select diagonal (causal kernel), past (full kernel), or future (zero
+    contribution) — chip-local branching only, see module docstring."""
+    from ..ops.flash_attention import flash_attention_lse
+
+    if not causal:
+        return flash_attention_lse(q, k, v, kmask, False, block_q, block_k)
+
+    B, H, Lq, D = q.shape
+
+    def diag(_):
+        return flash_attention_lse(q, k, v, kmask, True, block_q, block_k)
+
+    def past(_):
+        return flash_attention_lse(q, k, v, kmask, False, block_q, block_k)
+
+    def future(_):
+        return (jnp.zeros((B, H, Lq, D), q.dtype),
+                jnp.full((B, H, Lq), NEG_INF, jnp.float32))
+
+    idx = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+    return jax.lax.switch(idx, (diag, past, future), None)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    pad_mask: Optional[jnp.ndarray] = None,
                    causal: bool = False,
-                   axis_name: str = "sequence") -> jnp.ndarray:
+                   axis_name: str = "sequence",
+                   use_flash: bool = True,
+                   block_q: int = 512, block_k: int = 512) -> jnp.ndarray:
     """Exact attention over sequence-sharded [B, H, L_local, Dh] inputs.
-    Must run inside ``shard_map`` with ``axis_name`` bound."""
+    Must run inside ``shard_map`` with ``axis_name`` bound.
+
+    Each hop yields a NORMALIZED block output plus its LSE; the cross-hop
+    fold re-weights by ``exp(lse - m_run)`` so the final result is exactly
+    global softmax attention. ``use_flash=False`` selects the dense einsum
+    per-hop reference (O((L/n)^2) score memory — tests only)."""
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     L_local = q.shape[-2]
@@ -84,13 +131,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def hop(carry, i):
         k_blk, v_blk, mask_blk, acc, m_run, l_run = carry
         src = (my - i) % n                # shard that produced this kv block
-        pv, m_blk, l_blk = _block_attn(q, k_blk, v_blk, mask_blk, causal,
-                                       q_off, src * L_local, sm_scale)
-        m_new = jnp.maximum(m_run, m_blk)
+        if use_flash:
+            out_blk, lse_blk = _flash_block_attn(
+                q, k_blk, v_blk, mask_blk, causal, my, src, block_q, block_k)
+        else:
+            out_blk, lse_blk = _dense_block_attn(
+                q, k_blk, v_blk, mask_blk, causal,
+                q_off, src * L_local, sm_scale)
+        m_new = jnp.maximum(m_run, lse_blk)
         alpha = jnp.exp(m_run - m_new)
-        beta = jnp.exp(m_blk - m_new)
-        acc = acc * alpha + pv * beta
-        l_run = l_run * alpha + l_blk * beta
+        beta = jnp.exp(lse_blk - m_new)
+        acc = acc * alpha[..., None] + out_blk.astype(jnp.float32) * beta[..., None]
+        l_run = l_run * alpha + beta
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
         mask_nxt = (jax.lax.ppermute(mask_blk, axis_name, perm)
@@ -99,17 +151,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     B, H, _, D = q.shape
     acc0 = jnp.zeros((B, H, L_local, D), jnp.float32)
-    m0 = jnp.full((B, H, L_local, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, L_local, 1), jnp.float32)
+    m0 = jnp.full((B, H, L_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, L_local), jnp.float32)
     (_, _, _, acc, _, l), _ = jax.lax.scan(
         hop, (k, v, pad_mask, acc0, m0, l0), jnp.arange(n))
-    return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
+    return (acc / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
 def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                            pad_mask: Optional[jnp.ndarray] = None,
                            causal: bool = False,
-                           mesh=None) -> jnp.ndarray:
+                           mesh=None,
+                           use_flash: bool = True) -> jnp.ndarray:
     """Ring attention on GLOBAL [B, H, L, Dh] arrays: wraps
     :func:`ring_attention` in ``shard_map`` over the ambient (or given) mesh,
     sharding batch over (data, fsdp), heads over tensor, sequence over the
@@ -142,12 +195,13 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     if pad_mask is None:
         fn = shard_map(
-            functools.partial(ring_attention, pad_mask=None, causal=causal),
+            functools.partial(ring_attention, pad_mask=None, causal=causal,
+                              use_flash=use_flash),
             mesh=mesh, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
             check_vma=False)
         return fn(q, k, v)
     fn = shard_map(
-        functools.partial(ring_attention, causal=causal),
+        functools.partial(ring_attention, causal=causal, use_flash=use_flash),
         mesh=mesh, in_specs=(qkv_spec,) * 3 + (mask_spec,),
         out_specs=qkv_spec, check_vma=False)
     return fn(q, k, v, pad_mask)

@@ -25,6 +25,19 @@ def create_parser() -> argparse.ArgumentParser:
     return TrainSettings.to_argparse(add_json=True)
 
 
+def resolve_run_dir(args: TrainSettings) -> str:
+    """Run dir: ``model_checkpoints/Run_{dataset}_lr{lr}_seed{seed}_{ts}``
+    (reference train.py:32-40). DPT_RUN_TIMESTAMP is pinned by the launcher
+    so every worker, every host, and every restart attempt resolves the SAME
+    dir — checkpoint auto-resume depends on it (parallel/launcher.py)."""
+    if args.checkpoint_path:
+        return args.checkpoint_path
+    ts = os.environ.get("DPT_RUN_TIMESTAMP") or time.strftime("%Y%m%d-%H%M%S")
+    return os.path.join(
+        "model_checkpoints",
+        f"Run_{args.dataset}_lr{args.lr}_seed{args.seed}_{ts}")
+
+
 def main(namespace: argparse.Namespace) -> None:
     """(reference run/train.py:10-121; late imports keep ``--help`` fast,
     mirroring the reference's in-function imports at train.py:15-24)"""
@@ -46,14 +59,7 @@ def main(namespace: argparse.Namespace) -> None:
     if args.debug_nans:  # SURVEY.md §5.2: debug flag -> jax NaN checker
         jax.config.update("jax_debug_nans", True)
 
-    # Run dir: model_checkpoints/Run_{dataset}_lr{lr}_seed{seed}_{ts}
-    # (reference train.py:32-40), created by process 0.
-    ckpt_path = args.checkpoint_path
-    if not ckpt_path:
-        ts = time.strftime("%Y%m%d-%H%M%S")
-        ckpt_path = os.path.join(
-            "model_checkpoints",
-            f"Run_{args.dataset}_lr{args.lr}_seed{args.seed}_{ts}")
+    ckpt_path = resolve_run_dir(args)  # created by process 0
     if rank == 0:
         os.makedirs(ckpt_path, exist_ok=True)
     dist.barrier("mkdir")
@@ -91,10 +97,28 @@ def main(namespace: argparse.Namespace) -> None:
         except Exception as e:
             logger.warn(f"wandb unavailable: {e}")
 
+    eval_callbacks = []
+    if args.eval_decode:
+        # End-task quality during training: decode ONE held-out batch at
+        # every eval interval. Every process joins the callback's jit (the
+        # params are globally sharded — see TrainLoop.run_loop), so every
+        # host must see the SAME batch: host_sharded=False. One cached
+        # batch, no prefetch workers, capped size (decoding is many model
+        # fwds per example; the training batch would be slow).
+        from ..models.sampling import make_decode_callback
+        decode_data = load_data_from_args(
+            "valid", **{**args.dict(), "deterministic": True,
+                        "batch_size": min(args.batch_size, 32),
+                        "num_loader_proc": 0, "data_loader_workers": 0,
+                        "host_sharded": False})
+        eval_callbacks.append(make_decode_callback(
+            decode_data, sample_steps=args.eval_decode_sample_steps))
+
     loop = TrainLoop(
         model=workload,
         data=data,
         eval_data=eval_data,
+        eval_callbacks=eval_callbacks,
         batch_size=args.batch_size,
         microbatch=args.microbatch,
         lr=args.lr,

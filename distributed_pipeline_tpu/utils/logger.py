@@ -350,10 +350,14 @@ class Logger:
 
     # logkv_mean folds its raw-value buffer into a (sum, count) pair whenever
     # it reaches this many entries, so huge log_intervals can't pin an
-    # unbounded list of device scalars (the fold only touches values logged
-    # >= MEAN_BUF_CAP steps ago — long since computed, so float() is a cheap
-    # copy, not a pipeline stall).
+    # unbounded list of device scalars. The fold keeps the newest
+    # MEAN_BUF_KEEP entries raw: those may be in-flight device scalars from
+    # the current step (a caller may log one key up to MEAN_BUF_KEEP times
+    # per step), and float() on an in-flight scalar would stall the
+    # pipeline — the exact sync this buffering avoids. Everything older is
+    # long since computed, so float() is a cheap copy.
     MEAN_BUF_CAP = 256
+    MEAN_BUF_KEEP = 32
 
     def __init__(self, dir: Optional[str], output_formats: Sequence[KVWriter],
                  comm: Any = None):
@@ -378,13 +382,11 @@ class Logger:
         buf = self.name2mean.setdefault(key, [])
         buf.append(val)
         if len(buf) >= self.MEAN_BUF_CAP:
-            # Fold all but the newest entry: the newest may be an in-flight
-            # device scalar from the current step, and float() on it would
-            # stall the pipeline — the exact sync this buffering avoids.
+            keep = self.MEAN_BUF_KEEP
             folded = self.name2mean_folded.setdefault(key, [0.0, 0])
-            folded[0] += sum(float(v) for v in buf[:-1])
-            folded[1] += len(buf) - 1
-            del buf[:-1]
+            folded[0] += sum(float(v) for v in buf[:-keep])
+            folded[1] += len(buf) - keep
+            del buf[:-keep]
 
     def merged_kvs(self) -> Dict[str, Any]:
         """Overwrite-keys plus materialized means (device scalars become

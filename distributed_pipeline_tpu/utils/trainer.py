@@ -411,9 +411,15 @@ class TrainLoop:
                 if (self.eval_data is not None
                         and self.step % self.eval_interval == 0):
                     self.forward_only(next(self.eval_data))
-                    if jax.process_index() == 0:
-                        for cb in self.eval_callbacks:
-                            cb(self)
+                    # Reference runs callbacks on rank 0 only
+                    # (trainer.py:189-191) because torch callbacks are
+                    # host-local. Here they may jit over globally-sharded
+                    # params (e.g. the decode callback), and in
+                    # multi-controller JAX every process must join such a
+                    # computation — so ALL processes run the callbacks and
+                    # output stays rank-gated in the logger sinks.
+                    for cb in self.eval_callbacks:
+                        cb(self)
                 if self.step % self.save_interval == 0:
                     self.save()
         finally:

@@ -139,3 +139,16 @@ def test_config_json_ignores_hosting_process_argv(tmp_path, monkeypatch):
     ns = parser.parse_args(["--config_json", str(cfg)])
     settings = TrainSettings.from_argparse(ns)  # must not raise
     assert settings.seed == TrainSettings().seed
+
+
+def test_abbreviated_flags_rejected():
+    """ADVICE r2: allow_abbrev=False — a prefix-abbreviated flag (--log_int)
+    must be an argparse error, not silently accepted (it would dodge the
+    --config_json mutual-exclusivity scan, which matches exact field names)."""
+    from distributed_pipeline_tpu.config.train import TrainSettings
+
+    parser = TrainSettings.to_argparse(add_json=True)
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--log_int", "50"])
+    ns = parser.parse_args(["--log_interval", "50"])  # exact name still works
+    assert ns.log_interval == 50

@@ -140,6 +140,12 @@ def test_logkv_mean_bounded_buffer(tmp_path):
         for i in range(n):
             logger.logkv_mean("m", float(i))
             assert len(cur.name2mean["m"]) < logger.Logger.MEAN_BUF_CAP
+            # The fold must keep the newest MEAN_BUF_KEEP entries raw — they
+            # may be in-flight device scalars from the current step (ADVICE
+            # r2: a key logged up to MEAN_BUF_KEEP times per step never has
+            # an in-flight value float()ed).
+            assert len(cur.name2mean["m"]) >= min(
+                i + 1, logger.Logger.MEAN_BUF_KEEP)
         d = logger.dumpkvs()
     assert d["m"] == pytest.approx(sum(range(n)) / n)
 

@@ -164,6 +164,28 @@ def test_flash_odd_length_direct_call():
         assert jnp.allclose(a, b, atol=2e-3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_with_padding_mask(causal):
+    """ADVICE r2: gradient parity THROUGH a pad mask (the riskiest backward
+    path — lse/delta with masked keys) with batch-varying valid lengths."""
+    q, k, v = _rand_qkv(23, B=3, H=2, L=96, Dh=32)
+    lens = jnp.array([96, 41, 7])  # full, partial, nearly-empty
+    mask = (jnp.arange(96)[None, :] < lens[:, None]).astype(jnp.int32)
+
+    gf = jax.grad(
+        lambda *a: (flash_attention(*a, mask, causal, 16, 16) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(
+        lambda *a: (_xla_attention(*a, mask, causal) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        assert jnp.allclose(a, b, atol=2e-4), float(jnp.abs(a - b).max())
+    # masked-out keys must receive (near-)zero gradient
+    gk, gv = gf[1], gf[2]
+    assert float(jnp.abs(gk[1, :, 41:]).max()) < 1e-6
+    assert float(jnp.abs(gv[2, :, 7:]).max()) < 1e-6
+
+
 def test_flash_fully_masked_rows_zero_grads():
     """Fully-masked rows emit exact zeros forward (not a softmax over raw
     scores) and contribute zero gradient."""

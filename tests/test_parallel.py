@@ -134,6 +134,47 @@ def test_multiprocess_end_to_end_training(tmp_path):
     assert (tmp_path / "model_000006").is_dir()  # multi-host Orbax save
 
 
+def test_resolve_run_dir_uses_pinned_timestamp(monkeypatch):
+    """ADVICE r2 medium: restart supervision only works if every attempt
+    resolves the SAME auto-generated run dir — the launcher pins
+    DPT_RUN_TIMESTAMP and run/train derives the dir from it."""
+    from distributed_pipeline_tpu.config.train import TrainSettings
+    from distributed_pipeline_tpu.run.train import resolve_run_dir
+
+    args = TrainSettings()
+    monkeypatch.setenv("DPT_RUN_TIMESTAMP", "19990101-000000")
+    d1, d2 = resolve_run_dir(args), resolve_run_dir(args)
+    assert d1 == d2 and d1.endswith("19990101-000000")
+    # explicit --checkpoint_path always wins
+    explicit = TrainSettings(checkpoint_path="/x/y")
+    assert resolve_run_dir(explicit) == "/x/y"
+
+
+def test_launcher_pins_timestamp_across_attempts(monkeypatch):
+    """run_argv_as_distributed must hand every attempt's workers the SAME
+    DPT_RUN_TIMESTAMP (so respawned rings resolve the same run dir) WITHOUT
+    mutating this process's environ (a second launch from the same process
+    must mint a fresh timestamp, not resume run 1's checkpoints)."""
+    import os
+
+    from distributed_pipeline_tpu.parallel import launcher
+
+    monkeypatch.delenv("DPT_RUN_TIMESTAMP", raising=False)
+    seen = []
+
+    def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
+                  run_timestamp=None):
+        seen.append(run_timestamp)
+        return 1 if len(seen) < 2 else 0  # fail once, then succeed
+
+    monkeypatch.setattr(launcher, "_run_worker_ring", fake_ring)
+    code = launcher.run_argv_as_distributed("mod", [], nprocs=2,
+                                            max_restarts=3)
+    assert code == 0
+    assert len(seen) == 2 and seen[0] is not None and seen[0] == seen[1]
+    assert "DPT_RUN_TIMESTAMP" not in os.environ  # no process-global leak
+
+
 def test_launcher_restart_supervision_resumes_past_checkpoint(tmp_path):
     """VERDICT r1 #6: SIGKILL a worker mid-run; with --max_restarts the
     launcher respawns the ring and checkpoint auto-resume continues the job

@@ -1,0 +1,148 @@
+"""Inference tests: DiffuSeq reverse-process sampling, GPT-2 greedy decode,
+the eval-time decode callback, and the run.sample CLI entry (VERDICT r2 #7:
+checkpoints must be consumable, and a briefly-trained tiny model must decode
+the synthetic mapping better than chance)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.data import load_data_from_args
+from distributed_pipeline_tpu.models import create_model_from_config
+from distributed_pipeline_tpu.models.sampling import (
+    diffuseq_sample,
+    gpt2_greedy_decode,
+    make_decode_callback,
+    target_span_accuracy,
+)
+from distributed_pipeline_tpu.parallel import make_mesh
+from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+VOCAB = 32
+SEQ = 16
+
+
+def tiny_workload(fam="diffuseq"):
+    return create_model_from_config(
+        model_family=fam, vocab_size=VOCAB, seq_len=SEQ, hidden_size=64,
+        num_layers=2, num_heads=2, diffusion_steps=50, dtype="float32")
+
+
+def valid_batch(fam="diffuseq", batch_size=32):
+    name = "synthetic-lm" if fam == "gpt2" else "synthetic-seq2seq"
+    data = load_data_from_args("valid", batch_size=batch_size, dataset=name,
+                               seq_len=SEQ, vocab_size=VOCAB, seed=0,
+                               deterministic=True)
+    return jax.tree_util.tree_map(jnp.asarray, next(data))
+
+
+def train_briefly(fam, steps, tmp_path, lr=3e-3, batch_size=32, **kw):
+    wl = tiny_workload(fam)
+    name = "synthetic-lm" if fam == "gpt2" else "synthetic-seq2seq"
+    data = load_data_from_args("train", batch_size=batch_size, dataset=name,
+                               seq_len=SEQ, vocab_size=VOCAB, seed=0)
+    loop = TrainLoop(model=wl, data=data, batch_size=batch_size, lr=lr,
+                     ema_rate="0.99", learning_steps=0,
+                     log_interval=10 ** 9, save_interval=10 ** 9,
+                     mesh=make_mesh(dp=8), checkpoint_dir=str(tmp_path), **kw)
+    for _ in range(steps):
+        loop.run_step(next(loop.data))
+    return wl, loop
+
+
+def test_diffuseq_sample_preserves_source_and_shapes():
+    wl = tiny_workload()
+    params = wl.init_params(jax.random.PRNGKey(0))
+    batch = valid_batch(batch_size=8)
+    pred = diffuseq_sample(wl, params, batch, jax.random.PRNGKey(1),
+                           sample_steps=10)
+    assert pred.shape == batch["input_ids"].shape
+    src = batch["input_mask"] == 0
+    np.testing.assert_array_equal(np.asarray(pred)[np.asarray(src)],
+                                  np.asarray(batch["input_ids"])[np.asarray(src)])
+    assert int(pred.min()) >= 0 and int(pred.max()) < VOCAB
+
+
+def test_diffuseq_decode_beats_chance_after_training(tmp_path):
+    """~400 steps on the deterministic synthetic mapping must put target-span
+    token accuracy well above chance (1/VOCAB ~ 3%); longer training drives
+    it far higher (65% @ 1600 steps — the slow loss-floor test covers that)."""
+    wl, loop = train_briefly("diffuseq", 400, tmp_path)
+    batch = valid_batch()
+    with loop.mesh:
+        pred = diffuseq_sample(wl, loop.state.params, batch,
+                               jax.random.PRNGKey(1), sample_steps=25)
+    acc = float(target_span_accuracy(pred, batch))
+    assert acc > 2.0 / VOCAB, f"decode_acc {acc} not above chance"
+
+
+def test_gpt2_greedy_decode_mechanics():
+    wl = tiny_workload("gpt2")
+    params = wl.init_params(jax.random.PRNGKey(0))
+    batch = valid_batch("gpt2", batch_size=4)
+    plen = SEQ // 2
+    pred = gpt2_greedy_decode(wl, params, batch["input_ids"], plen)
+    # prompt untouched; suffix regenerated deterministically
+    np.testing.assert_array_equal(np.asarray(pred)[:, :plen],
+                                  np.asarray(batch["input_ids"])[:, :plen])
+    pred2 = gpt2_greedy_decode(wl, params, batch["input_ids"], plen)
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(pred2))
+    assert int(pred.min()) >= 0 and int(pred.max()) < VOCAB
+
+
+def test_decode_callback_logs_metric(tmp_path):
+    from distributed_pipeline_tpu.utils import logger
+
+    wl, loop = train_briefly("diffuseq", 2, tmp_path)
+    name = "synthetic-seq2seq"
+    data = load_data_from_args("valid", batch_size=8, dataset=name,
+                               seq_len=SEQ, vocab_size=VOCAB, seed=0,
+                               deterministic=True)
+    cb = make_decode_callback(data, sample_steps=5)
+    with logger.scoped_configure(dir=str(tmp_path / "logs"),
+                                 format_strs=["json"]):
+        cb(loop)
+        d = logger.dumpkvs()
+    assert "decode_acc" in d and 0.0 <= d["decode_acc"] <= 1.0
+
+
+def test_run_sample_cli_raw_and_ema(tmp_path):
+    """run.sample end-to-end off a real run dir: training_args.json recovery,
+    newest-checkpoint discovery, raw AND EMA param loading, JSONL output."""
+    from distributed_pipeline_tpu.run import sample as run_sample
+
+    wl, loop = train_briefly("diffuseq", 3, tmp_path / "run")
+    loop.save()
+    targs = dict(model_family="diffuseq", model_size="base",
+                 vocab_size=VOCAB, seq_len=SEQ, hidden_size=64,
+                 num_layers=2, num_heads=2, diffusion_steps=50,
+                 noise_schedule="sqrt", dtype="float32",
+                 dataset="synthetic-seq2seq", seed=0)
+    with open(tmp_path / "run" / "training_args.json", "w") as f:
+        json.dump(targs, f)
+
+    out_file = tmp_path / "samples.jsonl"
+    ns = run_sample.create_parser().parse_args(
+        ["--checkpoint_path", str(tmp_path / "run"),
+         "--batch_size", "8", "--num_batches", "1",
+         "--sample_steps", "5", "--out", str(out_file)])
+    res = run_sample.main(ns)
+    assert res["step"] == 3 and res["params"] == "raw"
+    assert 0.0 <= res["decode_acc"] <= 1.0 and np.isfinite(res["eval_loss"])
+    rows = [json.loads(l) for l in out_file.read_text().splitlines()]
+    assert len(rows) == 8 and set(rows[0]) == {"gold", "pred"}
+
+    ns_ema = run_sample.create_parser().parse_args(
+        ["--checkpoint_path", str(tmp_path / "run"), "--ema", "0.99",
+         "--batch_size", "8", "--num_batches", "1", "--sample_steps", "5"])
+    res_ema = run_sample.main(ns_ema)
+    assert res_ema["params"] == "ema_0.99"
+
+    with pytest.raises(FileNotFoundError):
+        bad = run_sample.create_parser().parse_args(
+            ["--checkpoint_path", str(tmp_path / "run"), "--ema", "0.123"])
+        run_sample.main(bad)
