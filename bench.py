@@ -1,10 +1,15 @@
-"""Benchmark: DiffuSeq-base training throughput on the available hardware.
+"""Benchmark: training throughput on the available hardware, per BASELINE.md
+config shape.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...,
+   "configs": [...per-shape results...]}
 
 The headline config is BASELINE.md's north star (DiffuSeq-base, seq_len=128,
-bf16). The reference publishes no absolute numbers (BASELINE.md), so
+bf16); the ``configs`` list covers the other single-chip-benchable BASELINE
+shapes: the grad-accum path (config 3 semantics), DiffuSeq-large @ seq 512
+with and without rematerialization (config 3 shape), and GPT-2-medium
+(config 4). The reference publishes no absolute numbers (BASELINE.md), so
 ``vs_baseline`` reports achieved MFU / the 40% MFU target from
 /root/repo/BASELINE.json.
 """
@@ -17,8 +22,13 @@ import time
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
+    from distributed_pipeline_tpu.utils import logger
+    # stdout is the ONE machine-readable JSON line: silence the logger's
+    # sinks (the default logger would print "Logging to ..." on first use).
+    logger.configure(format_strs=[])
+
+    from distributed_pipeline_tpu.data import load_data_from_args
     from distributed_pipeline_tpu.models import create_model_from_config
     from distributed_pipeline_tpu.parallel import make_mesh
     from distributed_pipeline_tpu.utils.perf import (
@@ -28,30 +38,50 @@ def main() -> None:
     from distributed_pipeline_tpu.utils.trainer import TrainLoop
 
     on_tpu = jax.default_backend() == "tpu"
-    seq_len = 128
-    # Per-chip batch 256 is the measured MFU sweet spot at base scale
-    # (64/128/256/512 sweep on v5e); tiny on CPU so smoke runs finish fast.
-    # batch is PER HOST (trainer.py:89 semantics), so scale by the host's
-    # local chips, not the global device count.
-    batch = 256 * jax.local_device_count() if on_tpu else 8
+    dtype = "bfloat16" if on_tpu else "float32"
     steps = 30 if on_tpu else 3
-    wl = create_model_from_config(
-        model_family="diffuseq", model_size="base", vocab_size=8192,
-        seq_len=seq_len, dtype="bfloat16" if on_tpu else "float32")
 
-    from distributed_pipeline_tpu.data import load_data_from_args
-    data = load_data_from_args("train", batch_size=batch,
-                               dataset="synthetic-seq2seq", seq_len=seq_len,
-                               vocab_size=8192, seed=0, num_loader_proc=2)
-
-    def measure(microbatch: int):
-        """tokens/sec (global: per-host batch x hosts, trainer.py:89) for one
-        accumulation config; warmup step compiles, then a timed window."""
+    def measure(name: str, *, family: str, size: str, seq_len: int,
+                batch, microbatch: int = 0, remat: bool = False,
+                vocab: int = 8192):
+        """tokens/sec for one config; warmup step compiles, then a timed
+        window. ``batch`` is PER HOST (reference trainer.py:89 semantics:
+        global = batch x hosts); a tuple tries sizes left-to-right and falls
+        back on HBM OOM (the driver runs this unattended — a too-ambitious
+        batch must degrade, not abort the whole bench)."""
+        if isinstance(batch, tuple):
+            for i, b in enumerate(batch):
+                try:
+                    return measure(name, family=family, size=size,
+                                   seq_len=seq_len, batch=b,
+                                   microbatch=microbatch, remat=remat,
+                                   vocab=vocab)
+                except Exception as e:
+                    if i == len(batch) - 1:
+                        raise
+                    # stderr: stdout is the ONE machine-readable JSON line
+                    import sys
+                    print(f"# {name}: batch {b} failed ({type(e).__name__}); "
+                          f"retrying with {batch[i + 1]}", file=sys.stderr,
+                          flush=True)
+        # Off-TPU (CPU smoke): shrink the model so every config still
+        # EXERCISES its code path (remat, grad-accum, families) in seconds;
+        # real preset sizes only matter on the hardware being measured.
+        dims = dict(vocab_size=vocab) if on_tpu else dict(
+            hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
+        wl = create_model_from_config(
+            model_family=family, model_size=size,
+            seq_len=seq_len, dtype=dtype, remat=remat, **dims)
+        dataset = "synthetic-lm" if family == "gpt2" else "synthetic-seq2seq"
+        data = load_data_from_args("train", batch_size=batch, dataset=dataset,
+                                   seq_len=seq_len,
+                                   vocab_size=dims["vocab_size"], seed=0,
+                                   num_loader_proc=2)
         loop = TrainLoop(model=wl, data=data, batch_size=batch,
-                         microbatch=microbatch, lr=1e-4, ema_rate="0.9999",
-                         learning_steps=0, log_interval=10 ** 9,
-                         save_interval=10 ** 9, mesh=make_mesh(dp=-1),
-                         checkpoint_dir="", seed=0)
+                         microbatch=microbatch or batch, lr=1e-4,
+                         ema_rate="0.9999", learning_steps=0,
+                         log_interval=10 ** 9, save_interval=10 ** 9,
+                         mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0)
         m = loop.run_step(next(loop.data))
         jax.block_until_ready(m["loss"])
         t0 = time.perf_counter()
@@ -59,30 +89,53 @@ def main() -> None:
             m = loop.run_step(next(loop.data))
         jax.block_until_ready(m["loss"])
         dt = time.perf_counter() - t0
-        return steps * batch * seq_len * jax.process_count() / dt, loop.n_params
+        tps = steps * batch * seq_len * jax.process_count() / dt
+        fpt = transformer_train_flops_per_token(
+            loop.n_params, wl.num_layers, wl.hidden_size, seq_len)
+        return {
+            "name": name,
+            "tokens_per_sec_per_chip": round(tps / jax.device_count(), 1),
+            "mfu": round(mfu(tps, fpt), 4),
+            "n_params": loop.n_params,
+            "batch": batch, "microbatch": microbatch or batch,
+            "seq_len": seq_len, "remat": remat,
+        }
 
-    # headline: no accumulation (BASELINE config 2 shape) ...
-    tokens_per_sec, n_params = measure(microbatch=batch)
-    # ... plus the grad-accum path (BASELINE config 3: microbatch < batch,
-    # lax.scan accumulation inside the jitted step).
-    accum_tokens_per_sec, _ = measure(microbatch=max(batch // 4, 1))
+    # Per-chip batch sizes are the measured MFU sweet spots on v5e (base:
+    # 64/128/256/512 sweep in r2; large/gpt2 sized to fit one chip's HBM
+    # with the single-EMA bench loop); tiny on CPU so smoke runs finish.
+    bsz = (lambda b: b if on_tpu else 4)
+    configs = [
+        # headline: BASELINE config 2 shape, no accumulation
+        measure("diffuseq-base-seq128", family="diffuseq", size="base",
+                seq_len=128, batch=bsz(256)),
+        # config 3 semantics: microbatch < batch, lax.scan accumulation
+        measure("diffuseq-base-seq128-gradaccum", family="diffuseq",
+                size="base", seq_len=128, batch=bsz(256),
+                microbatch=bsz(256) // 4 or 1),
+        # config 3 shape: large model, long sequence, +/- remat (non-remat
+        # materializes [B, H, 512, 512] scores per layer -> smaller batch)
+        measure("diffuseq-large-seq512", family="diffuseq", size="large",
+                seq_len=512, batch=(bsz(32), bsz(16), bsz(8))),
+        measure("diffuseq-large-seq512-remat", family="diffuseq",
+                size="large", seq_len=512, batch=(bsz(64), bsz(32), bsz(16)),
+                remat=True),
+        # config 4: the causal-LM path (different xent/attention profile)
+        measure("gpt2-medium-seq128", family="gpt2", size="medium",
+                seq_len=128, batch=(bsz(128), bsz(64), bsz(32))),
+    ]
 
-    per_chip = tokens_per_sec / jax.device_count()
-    fpt = transformer_train_flops_per_token(
-        n_params, wl.num_layers, wl.hidden_size, seq_len)
-    achieved_mfu = mfu(tokens_per_sec, fpt)
+    head = configs[0]
     print(json.dumps({
         "metric": "tokens/sec/chip (DiffuSeq-base seq128 train, "
                   f"{jax.devices()[0].device_kind})",
-        "value": round(per_chip, 1),
+        "value": head["tokens_per_sec_per_chip"],
         "unit": "tokens/s/chip",
-        "vs_baseline": round(achieved_mfu / 0.40, 4),
-        "mfu": round(achieved_mfu, 4),
-        "grad_accum_tokens_per_sec_per_chip": round(
-            accum_tokens_per_sec / jax.device_count(), 1),
-        "grad_accum_mfu": round(mfu(accum_tokens_per_sec, fpt), 4),
-        "n_params": n_params,
+        "vs_baseline": round(head["mfu"] / 0.40, 4),
+        "mfu": head["mfu"],
+        "n_params": head["n_params"],
         "n_devices": jax.device_count(),
+        "configs": configs,
     }))
 
 

@@ -137,22 +137,34 @@ class SyntheticLMDataset:
 
 
 class WordVocab:
-    """Minimal whitespace-token vocabulary with stable hashing fallback.
+    """Whitespace-token vocabulary with three encoding modes, picked from the
+    file it is given (replacing the tokenizer the reference expects the user
+    to bring, ``/root/reference/data/dataset.py`` TODO):
 
-    Replaces the tokenizer the reference expects the user to bring
-    (``/root/reference/data/dataset.py`` TODO). A real run can drop in a
-    ``vocab.json`` (token -> id); absent that, tokens hash into the id space,
-    which is stable across hosts and runs (no Python hash randomization).
+    * a trained BPE artifact (``{"type": "bpe", ...}`` from
+      ``data/tokenizer.py``) -> subword encoding;
+    * a plain ``{token: id}`` mapping -> word-level encoding;
+    * no file -> tokens hash stably into the id space (no Python hash
+      randomization, identical across hosts and runs).
     """
 
     def __init__(self, vocab_size: int, vocab_file: Optional[str] = None):
         self.vocab_size = vocab_size
         self.token_to_id: Optional[Dict[str, int]] = None
+        self._bpe = None
         if vocab_file and os.path.exists(vocab_file):
             with open(vocab_file) as f:
-                self.token_to_id = json.load(f)
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and loaded.get("type") == "bpe":
+                from .tokenizer import BPEVocab
+                self._bpe = BPEVocab(loaded, vocab_size)
+                self.token_to_id = self._bpe.token_to_id
+            else:
+                self.token_to_id = loaded
 
     def encode(self, text: str) -> List[int]:
+        if self._bpe is not None:
+            return self._bpe.encode(text)
         out = []
         for tok in text.split():
             if self.token_to_id is not None:
@@ -177,8 +189,12 @@ class JsonlSeq2SeqDataset:
             raise FileNotFoundError(path)
         with open(path) as f:
             self.lines = [ln for ln in f if ln.strip()]
-        self.vocab = WordVocab(
-            vocab_size, vocab_file or os.path.join(data_dir, "vocab.json"))
+        if vocab_file is None:
+            # prefer a trained subword artifact over word-level vocab
+            bpe = os.path.join(data_dir, "bpe.json")
+            vocab_file = bpe if os.path.exists(bpe) else os.path.join(
+                data_dir, "vocab.json")
+        self.vocab = WordVocab(vocab_size, vocab_file)
         self.seq_len = seq_len
         self.vocab_size = vocab_size
 

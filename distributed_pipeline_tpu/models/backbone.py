@@ -42,16 +42,26 @@ def _dense_init(fan_in: int):
 
 class SelfAttention(nn.Module):
     """Multi-head self-attention. QKV fused into one [D, 3, H, Dh] matmul
-    (one MXU pass instead of three)."""
+    (one MXU pass instead of three).
+
+    ``decode=True`` adds an autoregressive KV cache (the "cache" variable
+    collection): a full-length call is the PREFILL (runs normal causal
+    attention and writes every position's K/V), and a single-token call with
+    ``cache_index=i`` writes position i and attends to cache[0..i] — O(L)
+    work per generated token instead of a full O(L^2) re-forward. The
+    caller threads ``cache_index``; no mutable step counter hides in the
+    module (jit/scany-friendly)."""
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
     causal: bool = False
     attention_impl: str = "auto"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
-                 pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+                 pad_mask: Optional[jnp.ndarray],
+                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         B, L, D = x.shape
         H = self.num_heads
         assert D % H == 0, f"hidden {D} not divisible by heads {H}"
@@ -64,9 +74,42 @@ class SelfAttention(nn.Module):
             (H, Dh, D), jnp.float32)
         qkv = jnp.einsum("bld,dthk->tbhlk", x, qkv_w.astype(self.dtype))
         q, k, v = qkv[0], qkv[1], qkv[2]
-        o = dot_product_attention(q, k, v, pad_mask, causal=self.causal,
-                                  impl=self.attention_impl)
+        if self.decode:
+            o = self._cached_attention(q, k, v, pad_mask, cache_index)
+        else:
+            o = dot_product_attention(q, k, v, pad_mask, causal=self.causal,
+                                      impl=self.attention_impl)
         return jnp.einsum("bhlk,hkd->bld", o, out_w.astype(self.dtype))
+
+    def _cached_attention(self, q, k, v, pad_mask, cache_index):
+        B, H, L, Dh = q.shape
+        # Cache dims come from the first (prefill, full-length) call.
+        ck = self.variable("cache", "key", jnp.zeros, k.shape, k.dtype)
+        cv = self.variable("cache", "value", jnp.zeros, v.shape, v.dtype)
+        Lmax = ck.value.shape[2]
+        if L == Lmax:  # prefill: populate the whole cache
+            ck.value, cv.value = k, v
+            return dot_product_attention(q, k, v, pad_mask, causal=True,
+                                         impl=self.attention_impl)
+        if L != 1:
+            raise ValueError(
+                f"decode calls take the full length ({Lmax}, prefill) or a "
+                f"single token, got {L}")
+        if cache_index is None:
+            raise ValueError("single-token decode needs cache_index")
+        idx = jnp.asarray(cache_index, jnp.int32)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k, (0, 0, idx, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v, (0, 0, idx, 0))
+        # Positions beyond idx hold stale/unwritten entries; mask them.
+        # (Causality IS this mask — no triangle needed for one query row.)
+        live = (jnp.arange(Lmax) <= idx).astype(jnp.int32)[None, :]
+        live = jnp.broadcast_to(live, (B, Lmax))
+        if pad_mask is not None:
+            live = live * pad_mask
+        return dot_product_attention(q, ck.value, cv.value, live,
+                                     causal=False, impl="xla")
 
 
 class Mlp(nn.Module):
@@ -95,13 +138,16 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     causal: bool = False
     attention_impl: str = "auto"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
-                 pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+                 pad_mask: Optional[jnp.ndarray],
+                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
         x = x + SelfAttention(self.num_heads, self.dtype, self.causal,
-                              self.attention_impl, name="attn")(h, pad_mask)
+                              self.attention_impl, self.decode,
+                              name="attn")(h, pad_mask, cache_index)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         x = x + Mlp(self.dtype, name="mlp")(h)
         return x
@@ -121,15 +167,18 @@ class TransformerBackbone(nn.Module):
     remat: bool = False
     causal: bool = False
     attention_impl: str = "auto"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
-                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 pad_mask: Optional[jnp.ndarray] = None,
+                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         block_cls = Block
         if self.remat:
             block_cls = nn.remat(Block, prevent_cse=False,
                                  static_argnums=())  # save HBM: recompute in bwd
         for i in range(self.num_layers):
             x = block_cls(self.num_heads, self.dtype, self.causal,
-                          self.attention_impl, name=f"block_{i}")(x, pad_mask)
+                          self.attention_impl, self.decode,
+                          name=f"block_{i}")(x, pad_mask, cache_index)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x).astype(self.dtype)

@@ -21,7 +21,12 @@ __all__ = ["GPT2Model", "gpt2_losses"]
 
 
 class GPT2Model(nn.Module):
-    """Decoder-only causal LM with weight-tied output head."""
+    """Decoder-only causal LM with weight-tied output head.
+
+    ``decode=True`` (via ``model.clone(decode=True)``) enables the KV-cache
+    generation path: a full-length prefill call, then single-token calls
+    with ``cache_index=i`` (position embedding taken at i) — see
+    backbone.SelfAttention and models/sampling.py."""
 
     vocab_size: int
     seq_len: int
@@ -31,10 +36,12 @@ class GPT2Model(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     attention_impl: str = "auto"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
-                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 pad_mask: Optional[jnp.ndarray] = None,
+                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         B, L = ids.shape
         word_emb = nn.Embed(
             self.vocab_size, self.hidden_size,
@@ -45,13 +52,20 @@ class GPT2Model(nn.Module):
             "pos_emb", nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), (None, EMBED)),
             (self.seq_len, self.hidden_size), jnp.float32)
-        h = (word_emb(ids) + pos_emb[None, :L]).astype(self.dtype)
+        if cache_index is not None and L == 1:
+            pos = jax.lax.dynamic_slice(
+                pos_emb, (jnp.asarray(cache_index, jnp.int32), 0),
+                (1, self.hidden_size))[None]
+        else:
+            pos = pos_emb[None, :L]
+        h = (word_emb(ids) + pos).astype(self.dtype)
         if pad_mask is None:
             pad_mask = jnp.ones_like(ids)
         h = TransformerBackbone(self.num_layers, self.num_heads, self.dtype,
                                 self.remat, causal=True,
                                 attention_impl=self.attention_impl,
-                                name="backbone")(h, pad_mask)
+                                decode=self.decode,
+                                name="backbone")(h, pad_mask, cache_index)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the
         # HBM traffic of f32; softmax stats go to f32 downstream (ops/xent.py).
         return jnp.einsum("bld,vd->blv", h,

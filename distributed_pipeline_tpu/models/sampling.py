@@ -108,22 +108,46 @@ def diffuseq_sample(workload, params, batch: Dict[str, jnp.ndarray],
 
 
 def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
-                       prompt_len: int) -> jnp.ndarray:
-    """Greedily continue ``ids[:, :prompt_len]`` out to the full seq_len.
+                       prompt_len: int, use_cache: bool = True) -> jnp.ndarray:
+    """Greedily continue ``ids[:, :prompt_len]`` out to the full seq_len;
+    int32 [B, L] out.
 
-    Full forward per generated position (no KV cache): causality makes the
-    not-yet-written suffix invisible to position i-1's logits, so the
-    pre-filled tail can hold anything. int32 [B, L] out."""
+    ``use_cache=True`` (default) runs the KV-cache path: one full-length
+    prefill populates every layer's K/V cache (stale tail entries are
+    overwritten before any step can read them — causality guarantees it),
+    then each new token is one single-position forward, O(L) per token
+    instead of a full O(L^2) re-forward. ``use_cache=False`` recomputes the
+    full forward per position — the reference implementation the cache path
+    is tested against."""
     model = workload.model
-    L = ids.shape[1]
+    B, L = ids.shape
     pad = jnp.ones_like(ids)
 
-    def body(i, ids):
-        logits = model.apply(params, ids, pad)            # [B, L, V]
-        nxt = jnp.argmax(logits[:, i - 1], axis=-1).astype(ids.dtype)
-        return ids.at[:, i].set(nxt)
+    if not use_cache:
+        def body(i, ids):
+            logits = model.apply(params, ids, pad)        # [B, L, V]
+            nxt = jnp.argmax(logits[:, i - 1], axis=-1).astype(ids.dtype)
+            return ids.at[:, i].set(nxt)
 
-    return jax.lax.fori_loop(prompt_len, L, body, ids)
+        return jax.lax.fori_loop(prompt_len, L, body, ids)
+
+    dm = model.clone(decode=True)
+    logits, vars_ = dm.apply(params, ids, pad, mutable=["cache"])
+    first = jnp.argmax(logits[:, prompt_len - 1], axis=-1).astype(ids.dtype)
+    ids = ids.at[:, prompt_len].set(first) if prompt_len < L else ids
+
+    def body(i, carry):
+        ids, cache = carry
+        tok = jax.lax.dynamic_slice(ids, (0, i), (B, 1))
+        logits, updated = dm.apply(
+            {**params, "cache": cache}, tok, None, cache_index=i,
+            mutable=["cache"])
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(ids.dtype)
+        return ids.at[:, i + 1].set(nxt), updated["cache"]
+
+    ids, _ = jax.lax.fori_loop(prompt_len, L - 1, body,
+                               (ids, vars_["cache"]))
+    return ids
 
 
 def target_span_accuracy(pred_ids: jnp.ndarray,

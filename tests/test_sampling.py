@@ -94,6 +94,20 @@ def test_gpt2_greedy_decode_mechanics():
     assert int(pred.min()) >= 0 and int(pred.max()) < VOCAB
 
 
+def test_gpt2_kv_cache_matches_full_recompute():
+    """The KV-cache path (prefill + single-token steps, O(L)/token) must
+    reproduce the full-recompute greedy decode token for token."""
+    wl = tiny_workload("gpt2")
+    params = wl.init_params(jax.random.PRNGKey(3))
+    batch = valid_batch("gpt2", batch_size=4)
+    for plen in (1, SEQ // 2, SEQ - 2):
+        slow = gpt2_greedy_decode(wl, params, batch["input_ids"], plen,
+                                  use_cache=False)
+        fast = gpt2_greedy_decode(wl, params, batch["input_ids"], plen,
+                                  use_cache=True)
+        np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
+
+
 def test_decode_callback_logs_metric(tmp_path):
     from distributed_pipeline_tpu.utils import logger
 

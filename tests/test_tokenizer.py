@@ -1,0 +1,93 @@
+"""BPE tokenizer tests: training, encoding, artifact round-trip, and the
+jsonl dataset's automatic bpe.json pickup."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.data.dataset import (
+    JsonlSeq2SeqDataset,
+    N_RESERVED,
+    WordVocab,
+)
+from distributed_pipeline_tpu.data.tokenizer import BPEVocab, EOW, train_bpe
+
+CORPUS = ["the quick brown fox jumps over the lazy dog",
+          "the quicker the better said the quickest fox",
+          "lazy dogs dream of quick foxes"] * 10
+
+
+def test_train_bpe_learns_frequent_merges():
+    art = train_bpe(CORPUS, vocab_size=128)
+    assert art["type"] == "bpe" and art["merges"]
+    # "the" is the most frequent word: it must end up a single symbol
+    vocab = BPEVocab(art, 128)
+    assert vocab._bpe_word("the") == ["the" + EOW]
+    # every id is in range and above the reserved band
+    ids = vocab.encode(" ".join(CORPUS))
+    assert min(ids) >= N_RESERVED and max(ids) < 128
+
+
+def test_bpe_subwords_unseen_word():
+    art = train_bpe(CORPUS, vocab_size=128)
+    vocab = BPEVocab(art, 128)
+    # "quickly" never occurs, but shares subwords with quick/quicker
+    pieces = vocab._bpe_word("quickly")
+    assert 1 < len(pieces) <= len("quickly") + 1
+    ids = vocab.encode("quickly")
+    assert all(N_RESERVED <= i < 128 for i in ids)
+    # out-of-alphabet chars fall back to stable hashing, never crash
+    a, b = vocab.encode("éé"), vocab.encode("éé")
+    assert a == b
+
+
+def test_bpe_vocab_budget_respected():
+    art = train_bpe(CORPUS, vocab_size=40)
+    assert len(art["vocab"]) <= 40 - N_RESERVED
+    assert max(art["vocab"].values()) < 40
+
+
+def test_wordvocab_dispatches_on_artifact_type(tmp_path):
+    art = train_bpe(CORPUS, vocab_size=128)
+    bpe_file = tmp_path / "bpe.json"
+    bpe_file.write_text(json.dumps(art))
+    wv = WordVocab(128, str(bpe_file))
+    assert wv.encode("the") == BPEVocab(art, 128).encode("the")
+    # plain mapping file still means word-level
+    plain = tmp_path / "vocab.json"
+    plain.write_text(json.dumps({"the": 5}))
+    wv2 = WordVocab(128, str(plain))
+    assert wv2.encode("the") == [5]
+
+
+def test_jsonl_dataset_prefers_bpe_and_cli_trains_it(tmp_path):
+    rows = [{"src": s, "trg": t}
+            for s, t in zip(CORPUS, reversed(CORPUS))]
+    (tmp_path / "train.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows))
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_pipeline_tpu.data.tokenizer",
+         "--data_dir", str(tmp_path), "--vocab_size", "128"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    info = json.loads(out.stdout)
+    assert info["merges"] > 0 and (tmp_path / "bpe.json").exists()
+
+    ds = JsonlSeq2SeqDataset(str(tmp_path), "train", seq_len=32,
+                             vocab_size=128)
+    assert ds.vocab._bpe is not None  # bpe.json auto-picked
+    item = ds[0]
+    assert item["input_ids"].shape == (32,)
+    assert int(item["input_mask"].sum()) > 0
+    assert int(item["input_ids"].max()) < 128
+
+
+def test_bpe_vocab_size_mismatch_fails_loudly():
+    """An artifact trained for a larger vocab must not silently clamp ids
+    into a smaller embedding table."""
+    art = train_bpe(CORPUS, vocab_size=128)
+    with pytest.raises(ValueError):
+        BPEVocab(art, vocab_size=16)

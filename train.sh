@@ -1,0 +1,1 @@
+python3 -m distributed_pipeline_tpu.run.train --distributed --config_json train_config.json
