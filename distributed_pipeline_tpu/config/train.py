@@ -79,6 +79,9 @@ class ModelSettings(S):
     attention_impl: Literal["auto", "xla", "pallas", "ring"] = _(
         "auto", "attention kernel: XLA dot-product, pallas flash, or ring (SP)"
     )
+    moe_experts: int = _(0, "mixture-of-experts: expert count (0 = dense MLPs)")
+    moe_top_k: int = _(2, "MoE router top-k")
+    moe_every: int = _(2, "MoE replaces the MLP in every k-th block")
 
 
 class MeshSettings(S):
@@ -90,6 +93,7 @@ class MeshSettings(S):
     fsdp: int = _(1, "FSDP/zero param-sharding axis size")
     tensor: int = _(1, "tensor-parallel axis size")
     sequence: int = _(1, "sequence/context-parallel axis size (ring attention)")
+    expert: int = _(1, "expert-parallel axis size (MoE expert sharding)")
 
 
 class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):

@@ -78,10 +78,15 @@ class Workload:
         batch = jax.tree_util.tree_map(jnp.asarray, self.example_batch(1))
         if self.family == "diffuseq":
             t = jnp.zeros((1,), jnp.int32)
-            return self.model.init(rng, batch["input_ids"], t,
-                                   batch["pad_mask"],
-                                   method=DiffuSeqModel.init_variables)
-        return self.model.init(rng, batch["input_ids"], batch["pad_mask"])
+            variables = self.model.init(rng, batch["input_ids"], t,
+                                        batch["pad_mask"],
+                                        method=DiffuSeqModel.init_variables)
+        else:
+            variables = self.model.init(rng, batch["input_ids"],
+                                        batch["pad_mask"])
+        # init() materializes every collection; only "params" is trainable
+        # state ("losses" holds MoE aux sows — per-step outputs, not state).
+        return {k: v for k, v in variables.items() if k != "losses"}
 
     def param_count(self, params: Any) -> int:
         return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
@@ -107,6 +112,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                              noise_schedule: str = "sqrt",
                              dtype: str = "bfloat16", remat: bool = False,
                              attention_impl: str = "auto",
+                             moe_experts: int = 0, moe_top_k: int = 2,
+                             moe_every: int = 2,
                              **_unused: Any) -> Workload:
     """Build a :class:`Workload` from (a superset of) ``TrainSettings`` fields
     — callable as ``create_model_from_config(**settings.dict())`` exactly like
@@ -128,7 +135,9 @@ def create_model_from_config(*, model_family: str = "diffuseq",
         model = DiffuSeqModel(
             vocab_size=vocab_size, seq_len=seq_len, hidden_size=hidden,
             num_layers=layers, num_heads=heads, emb_dim=DIFFUSEQ_EMB_DIM,
-            dtype=jdtype, remat=remat, attention_impl=attention_impl)
+            dtype=jdtype, remat=remat, attention_impl=attention_impl,
+            moe_experts=moe_experts, moe_top_k=moe_top_k,
+            moe_every=moe_every)
         schedule = make_schedule(noise_schedule, diffusion_steps)
 
         def compute_losses(params, batch, rng):
@@ -144,7 +153,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
         model = GPT2Model(
             vocab_size=vocab_size, seq_len=seq_len, hidden_size=hidden,
             num_layers=layers, num_heads=heads, dtype=jdtype, remat=remat,
-            attention_impl=attention_impl)
+            attention_impl=attention_impl, moe_experts=moe_experts,
+            moe_top_k=moe_top_k, moe_every=moe_every)
 
         def compute_losses(params, batch, rng):
             return gpt2_losses(model, params, batch, rng)

@@ -132,13 +132,20 @@ class Mlp(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block (LN in f32 for stability)."""
+    """Pre-LN transformer block (LN in f32 for stability).
+
+    ``moe_experts > 0`` swaps the dense MLP for a top-k routed
+    mixture-of-experts (models/moe.py) — expert weights shard over the
+    mesh's ``expert`` axis."""
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
     causal: bool = False
     attention_impl: str = "auto"
     decode: bool = False
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_no_drop: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -149,7 +156,13 @@ class Block(nn.Module):
                               self.attention_impl, self.decode,
                               name="attn")(h, pad_mask, cache_index)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
-        x = x + Mlp(self.dtype, name="mlp")(h)
+        if self.moe_experts > 0:
+            from .moe import MoEMlp  # function-level: moe imports backbone
+            x = x + MoEMlp(self.moe_experts, self.moe_top_k,
+                           dtype=self.dtype, no_drop=self.moe_no_drop,
+                           name="moe")(h, pad_mask)
+        else:
+            x = x + Mlp(self.dtype, name="mlp")(h)
         return x
 
 
@@ -168,6 +181,10 @@ class TransformerBackbone(nn.Module):
     causal: bool = False
     attention_impl: str = "auto"
     decode: bool = False
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2  # MoE replaces the MLP in every moe_every-th block
+    moe_no_drop: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -178,7 +195,11 @@ class TransformerBackbone(nn.Module):
             block_cls = nn.remat(Block, prevent_cse=False,
                                  static_argnums=())  # save HBM: recompute in bwd
         for i in range(self.num_layers):
+            is_moe = (self.moe_experts > 0
+                      and i % self.moe_every == self.moe_every - 1)
             x = block_cls(self.num_heads, self.dtype, self.causal,
                           self.attention_impl, self.decode,
+                          self.moe_experts if is_moe else 0, self.moe_top_k,
+                          self.moe_no_drop,
                           name=f"block_{i}")(x, pad_mask, cache_index)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x).astype(self.dtype)

@@ -57,6 +57,10 @@ class DiffuSeqModel(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
     attention_impl: str = "auto"
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_no_drop: bool = False
 
     def setup(self) -> None:
         self.word_emb = nn.Embed(
@@ -82,6 +86,8 @@ class DiffuSeqModel(nn.Module):
         self.backbone = TransformerBackbone(
             self.num_layers, self.num_heads, self.dtype, self.remat,
             causal=False, attention_impl=self.attention_impl,
+            moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
+            moe_every=self.moe_every, moe_no_drop=self.moe_no_drop,
             name="backbone")
         self.out_proj = nn.Dense(
             self.emb_dim, kernel_init=nn.with_logical_partitioning(
@@ -145,7 +151,8 @@ def diffuseq_losses(model: DiffuSeqModel, schedule: DiffusionSchedule,
     # Partial noising: target span diffuses, source span anchors.
     x_t = jnp.where(tgt_mask[..., None] > 0, x_noisy, x_start)
 
-    x0_hat = model.apply(params, x_t, t, pad_mask)
+    x0_hat, mvars = model.apply(params, x_t, t, pad_mask,
+                                mutable=["losses"])
 
     mse = _masked_mean(jnp.mean((x0_hat - x_start) ** 2, axis=-1), tgt_mask)
     tT = _masked_mean(schedule.mean_flat_tT(x_start), tgt_mask)
@@ -153,4 +160,10 @@ def diffuseq_losses(model: DiffuSeqModel, schedule: DiffusionSchedule,
     decoder_nll = _masked_mean(token_cross_entropy(logits, ids), tgt_mask)
 
     loss = mse + tT + decoder_nll
-    return {"loss": loss, "mse": mse, "tT": tT, "decoder_nll": decoder_nll}
+    out = {"loss": loss, "mse": mse, "tT": tT, "decoder_nll": decoder_nll}
+    if jax.tree_util.tree_leaves(mvars.get("losses", {})):  # static: MoE model
+        from .moe import MOE_AUX_WEIGHT, moe_aux_from
+        aux = moe_aux_from(mvars)
+        out["moe_aux"] = aux
+        out["loss"] = loss + MOE_AUX_WEIGHT * aux
+    return out

@@ -37,6 +37,10 @@ class GPT2Model(nn.Module):
     remat: bool = False
     attention_impl: str = "auto"
     decode: bool = False
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_no_drop: bool = False
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
@@ -65,6 +69,10 @@ class GPT2Model(nn.Module):
                                 self.remat, causal=True,
                                 attention_impl=self.attention_impl,
                                 decode=self.decode,
+                                moe_experts=self.moe_experts,
+                                moe_top_k=self.moe_top_k,
+                                moe_every=self.moe_every,
+                                moe_no_drop=self.moe_no_drop,
                                 name="backbone")(h, pad_mask, cache_index)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the
         # HBM traffic of f32; softmax stats go to f32 downstream (ops/xent.py).
@@ -82,10 +90,17 @@ def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
     pad_mask = batch["pad_mask"]
     loss_mask = (batch["input_mask"] * pad_mask)[:, 1:].astype(jnp.float32)
 
-    logits = model.apply(params, ids, pad_mask)[:, :-1]  # predict ids[:, 1:]
+    logits, mvars = model.apply(params, ids, pad_mask, mutable=["losses"])
+    logits = logits[:, :-1]  # predict ids[:, 1:]
     targets = ids[:, 1:]
     nll = token_cross_entropy(logits, targets)
     denom = jnp.maximum(loss_mask.sum(), 1.0)
     loss = (nll * loss_mask).sum() / denom
-    return {"loss": loss, "nll": loss,
-            "ppl": jnp.exp(jnp.minimum(loss, 20.0))}
+    out = {"loss": loss, "nll": loss,
+           "ppl": jnp.exp(jnp.minimum(loss, 20.0))}
+    if jax.tree_util.tree_leaves(mvars.get("losses", {})):  # static: MoE model
+        from .moe import MOE_AUX_WEIGHT, moe_aux_from
+        aux = moe_aux_from(mvars)
+        out["moe_aux"] = aux
+        out["loss"] = loss + MOE_AUX_WEIGHT * aux
+    return out

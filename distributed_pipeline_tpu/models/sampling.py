@@ -64,7 +64,8 @@ def diffuseq_sample(workload, params, batch: Dict[str, jnp.ndarray],
     DDIM (eta=0) update over a strided timestep subset; ``clamp=True``
     projects each x0 estimate to its nearest embedding (DiffuSeq's rounding
     trick — keeps the trajectory on the decodable manifold)."""
-    model: DiffuSeqModel = workload.model
+    # MoE models: exact per-token routing at inference (no capacity drops).
+    model: DiffuSeqModel = workload.model.clone(moe_no_drop=True)
     sched = workload.schedule
     ids = batch["input_ids"]
     tgt = batch["input_mask"][..., None] > 0              # [B, L, 1]
@@ -119,7 +120,10 @@ def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
     instead of a full O(L^2) re-forward. ``use_cache=False`` recomputes the
     full forward per position — the reference implementation the cache path
     is tested against."""
-    model = workload.model
+    # Inference never drops MoE tokens (capacity competition is a training
+    # device; per-token top-k routing at decode time is exact and makes the
+    # cached and uncached paths bit-identical — models/moe.py).
+    model = workload.model.clone(moe_no_drop=True)
     B, L = ids.shape
     pad = jnp.ones_like(ids)
 

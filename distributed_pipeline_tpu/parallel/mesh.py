@@ -11,6 +11,10 @@ with named axes that the rest of the framework shards against:
 * ``tensor``   — tensor parallelism (reserved axis, SURVEY.md §2.2)
 * ``sequence`` — sequence/context parallelism for ring attention
                  (SURVEY.md §5.7 "leave a sequence mesh-axis name reserved")
+* ``expert``   — expert parallelism (GShard-style: batch shards over it in
+                 dense layers, MoE expert weights shard over it, and XLA
+                 emits the dispatch/combine all-to-alls from the einsum
+                 shardings — models/moe.py)
 
 Axis sizes come from ``MeshSettings`` (config/train.py); ``-1`` means "all
 remaining devices". Multi-host meshes use ``mesh_utils.create_device_mesh``
@@ -28,16 +32,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["AXES", "make_mesh", "resolve_axis_sizes", "batch_spec", "local_mesh_info"]
 
-AXES: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor")
+AXES: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor", "expert")
 
 
 def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
-                       tensor: int = 1,
-                       n_devices: Optional[int] = None) -> Tuple[int, int, int, int]:
+                       tensor: int = 1, expert: int = 1,
+                       n_devices: Optional[int] = None) -> Tuple[int, ...]:
     """Resolve ``-1`` axis sizes against the device count and validate the
-    product. Returns sizes in AXES order (data, fsdp, sequence, tensor)."""
+    product. Returns sizes in AXES order (data, fsdp, sequence, tensor,
+    expert)."""
     n = n_devices if n_devices is not None else jax.device_count()
-    sizes = {"data": dp, "fsdp": fsdp, "sequence": sequence, "tensor": tensor}
+    sizes = {"data": dp, "fsdp": fsdp, "sequence": sequence, "tensor": tensor,
+             "expert": expert}
     unknown = [k for k, v in sizes.items() if v == -1]
     if len(unknown) > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
@@ -55,6 +61,7 @@ def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
 
 
 def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
+              expert: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the framework mesh. Works for 1 device (all axes size 1 except
     one) through multi-host pods; on real TPU slices
@@ -63,7 +70,7 @@ def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
         devices = jax.devices()
     n = len(devices)
     shape = resolve_axis_sizes(dp=dp, fsdp=fsdp, sequence=sequence,
-                               tensor=tensor, n_devices=n)
+                               tensor=tensor, expert=expert, n_devices=n)
     try:
         from jax.experimental import mesh_utils
         device_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
@@ -73,10 +80,12 @@ def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
 
 
 def batch_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
-    """PartitionSpec for a [batch, seq, ...] array: batch over data+fsdp
-    (FSDP ranks still consume distinct data shards — ZeRO semantics), and
-    optionally seq over the sequence axis (ring attention)."""
-    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or None
+    """PartitionSpec for a [batch, seq, ...] array: batch over
+    data+fsdp+expert (FSDP/expert ranks still consume distinct data shards —
+    ZeRO/GShard semantics), and optionally seq over the sequence axis (ring
+    attention)."""
+    batch_axes = tuple(a for a in ("data", "fsdp", "expert")
+                       if mesh.shape[a] > 1) or None
     if isinstance(batch_axes, tuple) and len(batch_axes) == 1:
         batch_axes = batch_axes[0]
     if seq_sharded and mesh.shape["sequence"] > 1:

@@ -183,8 +183,8 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # trace must still work on a dp>1 mesh — axes that don't divide fall
     # back to replication).
     batch_axes, rem = [], B
-    for a in ("data", "fsdp"):
-        if mesh.shape[a] > 1 and rem % mesh.shape[a] == 0:
+    for a in ("data", "fsdp", "expert"):  # mirror mesh.batch_spec
+        if mesh.shape.get(a, 1) > 1 and rem % mesh.shape[a] == 0:
             batch_axes.append(a)
             rem //= mesh.shape[a]
     batch = tuple(batch_axes) or None

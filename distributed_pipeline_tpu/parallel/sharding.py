@@ -30,13 +30,17 @@ __all__ = ["LOGICAL_RULES", "param_shardings", "batch_shardings",
 #                     row-parallel; attention heads split across chips.
 #   vocab  -> tensor: embedding/logit matrix splits over vocab.
 LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
-    ("batch", ("data", "fsdp")),
+    ("batch", ("data", "fsdp", "expert")),
     ("vocab", "tensor"),
     ("embed", "fsdp"),
     ("mlp", "tensor"),
     ("heads", "tensor"),
     ("kv", None),
     ("length", "sequence"),
+    # MoE expert-weight leading dim -> expert parallelism (models/moe.py);
+    # the dispatch/combine einsums against batch-sharded activations make
+    # XLA emit the all-to-alls (GShard recipe).
+    ("expert", "expert"),
 )
 
 

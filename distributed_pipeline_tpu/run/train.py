@@ -79,7 +79,7 @@ def main(namespace: argparse.Namespace) -> None:
 
     workload = create_model_from_config(**args.dict())
     mesh = make_mesh(dp=args.dp, fsdp=args.fsdp, sequence=args.sequence,
-                     tensor=args.tensor)
+                     tensor=args.tensor, expert=args.expert)
     logger.info(local_mesh_info(mesh))
 
     if rank == 0:  # args snapshot for reproducibility (train.py:82-87)

@@ -141,13 +141,14 @@ class TrainLoop:
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         # global batch = per-host batch x hosts (reference trainer.py:89)
         self.global_batch = batch_size * jax.process_count()
-        dpf = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        dpf = (self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+               * self.mesh.shape["expert"])
         global_micro = self.microbatch * jax.process_count()
         if global_micro % dpf:
             raise ValueError(
                 f"global microbatch {global_micro} (= microbatch "
                 f"{self.microbatch} x {jax.process_count()} hosts) must be "
-                f"divisible by data x fsdp mesh axes = {dpf}")
+                f"divisible by data x fsdp x expert mesh axes = {dpf}")
         self._base_rng = jax.random.PRNGKey(seed)
 
         self._build_state(resume_checkpoint)

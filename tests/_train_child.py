@@ -23,6 +23,9 @@ parser.add_argument("--ckpt_dir", required=True)
 parser.add_argument("--steps", type=int, default=6)
 parser.add_argument("--save_interval", type=int, default=2)
 parser.add_argument("--die_at_step", type=int, default=0)
+parser.add_argument("--eval_decode", action="store_true",
+                    help="attach the decode eval callback (every process "
+                         "joins its jit over the globally-sharded params)")
 ns = par.parse_and_autorun(parser)
 par.setup_dist()
 
@@ -46,9 +49,20 @@ wl = create_model_from_config(
     num_layers=1, num_heads=2, diffusion_steps=50, dtype="float32")
 data = load_data_from_args("train", batch_size=batch, seq_len=16,
                            vocab_size=64, seed=0)
+callbacks = []
+if ns.eval_decode:
+    from distributed_pipeline_tpu.models.sampling import make_decode_callback
+
+    # host_sharded=False: the decode batch feeds a collective jit as a
+    # replicated array, so every host must hold the SAME bytes.
+    decode_data = load_data_from_args(
+        "valid", batch_size=4, seq_len=16, vocab_size=64, seed=0,
+        deterministic=True, host_sharded=False)
+    callbacks.append(make_decode_callback(decode_data, sample_steps=4))
 loop = TrainLoop(model=wl, data=data, batch_size=batch, microbatch=2,
                  lr=1e-3, ema_rate="0.9", learning_steps=ns.steps,
                  log_interval=10 ** 6, save_interval=ns.save_interval,
+                 eval_callbacks=callbacks,
                  mesh=make_mesh(dp=-1), checkpoint_dir=ns.ckpt_dir, seed=0)
 assert loop.global_batch == batch * jax.process_count(), loop.global_batch
 
@@ -66,6 +80,15 @@ while loop.step < ns.steps:
         loop.save()
 
 assert all(l == l for l in losses), f"NaN loss: {losses}"
+if ns.eval_decode:
+    # EVERY process joins the callback (it jits over the globally-sharded
+    # params — trainer.run_loop semantics); output is logger-rank-gated.
+    from distributed_pipeline_tpu.utils import logger as dpt_logger
+
+    for cb in loop.eval_callbacks:
+        cb(loop)
+    acc = dpt_logger.getkvs().get("decode_acc")
+    print(f"DECODE {rank} {acc}")
 if rank == 0:
     with open(os.path.join(ns.ckpt_dir, "trace.json"), "w") as f:
         json.dump({"first_step": ns.steps - len(losses) + 1,

@@ -47,10 +47,11 @@ def test_find_free_port():
 
 def test_resolve_axis_sizes():
     # Returns sizes in AXES order: (data, fsdp, sequence, tensor).
-    assert resolve_axis_sizes(dp=-1, n_devices=8) == (8, 1, 1, 1)
-    assert resolve_axis_sizes(dp=2, fsdp=-1, n_devices=8) == (2, 4, 1, 1)
-    assert resolve_axis_sizes(dp=2, fsdp=2, tensor=2, n_devices=8) == (2, 2, 1, 2)
-    assert resolve_axis_sizes(dp=2, fsdp=2, sequence=2, n_devices=8) == (2, 2, 2, 1)
+    assert resolve_axis_sizes(dp=-1, n_devices=8) == (8, 1, 1, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=-1, n_devices=8) == (2, 4, 1, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=2, tensor=2, n_devices=8) == (2, 2, 1, 2, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=2, sequence=2, n_devices=8) == (2, 2, 2, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=2, expert=2, n_devices=8) == (2, 2, 1, 1, 2)
     with pytest.raises(ValueError):
         resolve_axis_sizes(dp=3, n_devices=8)
     with pytest.raises(ValueError):
@@ -64,7 +65,8 @@ def test_resolve_axis_sizes():
 def test_make_mesh_shapes(axes):
     mesh = make_mesh(**axes)
     assert mesh.devices.size == 8
-    assert set(mesh.shape.keys()) == {"data", "fsdp", "sequence", "tensor"}
+    assert set(mesh.shape.keys()) == {"data", "fsdp", "sequence", "tensor",
+                                      "expert"}
 
 
 def test_mesh_psum_rides_sharding():
@@ -193,3 +195,16 @@ def test_launcher_restart_supervision_resumes_past_checkpoint(tmp_path):
     trace = json.loads((tmp_path / "trace.json").read_text())
     assert trace["first_step"] == 3, trace
     assert (tmp_path / "model_000006").is_dir()
+
+
+def test_multiprocess_decode_callback(tmp_path):
+    """The eval-decode callback jits over globally-sharded params, so EVERY
+    process must join it (code-review r3 finding): a 2-process ring runs the
+    callback on both ranks and they agree on the metric."""
+    out = _run_train_child(tmp_path, ["--steps", "2", "--save_interval", "5",
+                                      "--eval_decode"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    vals = dict(line.split()[1:3] for line in out.stdout.splitlines()
+                if line.startswith("DECODE "))
+    assert set(vals) == {"0", "1"}, out.stdout
+    assert vals["0"] == vals["1"] != "None"
