@@ -122,6 +122,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
     if model_family not in PRESETS:
         raise ValueError(f"unknown model family: {model_family!r}; "
                          f"available: {sorted(PRESETS)}")
+    if moe_experts > 0 and moe_every < 1:
+        raise ValueError(f"moe_every must be >= 1, got {moe_every}")
     preset = PRESETS[model_family].get(model_size)
     if preset is None:
         raise ValueError(f"no preset {model_size!r} for family {model_family!r}; "

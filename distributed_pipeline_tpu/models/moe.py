@@ -122,13 +122,12 @@ class MoEMlp(nn.Module):
         self.sow("losses", "moe_aux", aux,
                  init_fn=lambda: jnp.zeros(()), reduce_fn=jnp.add)
 
-        gate_mat = sum(g[..., None] * m for g, m in zip(gates, masks))
-        denom_all = jnp.maximum(sum(gates), 1e-9)            # [B, L]
-
         if self.no_drop:
             # Exact per-token mixture: every expert computed for every
             # token, combined by normalized top-k gates. E x the MLP FLOPs,
             # used on (cheap) inference paths only.
+            gate_mat = sum(g[..., None] * m for g, m in zip(gates, masks))
+            denom_all = jnp.maximum(sum(gates), 1e-9)        # [B, L]
             w = gate_mat / denom_all[..., None]              # [B, L, E]
             h = jnp.einsum("bld,edm->belm", x.astype(self.dtype),
                            wi.astype(self.dtype))

@@ -51,6 +51,25 @@ ERROR = 40
 DISABLED = 50
 
 
+def _fetch_all(values: Sequence[Any]) -> List[float]:
+    """Materialize a batch of (possibly device-resident) scalars as floats
+    with at most ONE device transfer. Per-value ``float()`` costs a full
+    round trip each — ruinous on remote/tunneled accelerators (see
+    ``Logger.merged_kvs``)."""
+    values = list(values)
+    try:
+        import jax
+
+        idx = [i for i, v in enumerate(values) if isinstance(v, jax.Array)]
+        if idx:
+            fetched = jax.device_get([values[i] for i in idx])
+            for i, f in zip(idx, fetched):
+                values[i] = f
+    except ImportError:  # pure-python usage of the logger
+        pass
+    return [float(v) for v in values]
+
+
 def _process_index() -> int:
     """Writer-rank detection without forcing JAX backend init.
 
@@ -384,18 +403,31 @@ class Logger:
         if len(buf) >= self.MEAN_BUF_CAP:
             keep = self.MEAN_BUF_KEEP
             folded = self.name2mean_folded.setdefault(key, [0.0, 0])
-            folded[0] += sum(float(v) for v in buf[:-keep])
+            folded[0] += sum(_fetch_all(buf[:-keep]))
             folded[1] += len(buf) - keep
             del buf[:-keep]
 
     def merged_kvs(self) -> Dict[str, Any]:
         """Overwrite-keys plus materialized means (device scalars become
-        floats here — the single sync point)."""
+        floats here — the single sync point). ALL buffered device scalars
+        transfer in ONE device_get: fetching them one-by-one costs a full
+        device round trip each, which on a remote-tunneled accelerator turns
+        a dump into a minute-long stall (measured 60s/dump on the v5e
+        tunnel at log_interval=100 — 4x total training slowdown)."""
         d = dict(self.name2val)
-        for key in set(self.name2mean) | set(self.name2mean_folded):
-            s, n = self.name2mean_folded.get(key, (0.0, 0))
+        keys = sorted(set(self.name2mean) | set(self.name2mean_folded))
+        flat: list = []
+        spans = {}
+        for key in keys:
             buf = self.name2mean.get(key, ())
-            total, count = s + sum(float(v) for v in buf), n + len(buf)
+            spans[key] = (len(flat), len(buf))
+            flat.extend(buf)
+        fetched = _fetch_all(flat)
+        for key in keys:
+            s, n = self.name2mean_folded.get(key, (0.0, 0))
+            start, ln = spans[key]
+            total = s + sum(fetched[start:start + ln])
+            count = n + ln
             if count:
                 d[key] = total / count
         return d

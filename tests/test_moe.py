@@ -127,7 +127,7 @@ def test_moe_routing_is_causal_under_capacity():
     are strictly positional-priority across BOTH top-k levels)."""
     wl = moe_workload()
     params = wl.init_params(jax.random.PRNGKey(0))
-    batch = valid = next(load_data_from_args(
+    batch = next(load_data_from_args(
         "valid", batch_size=2, dataset="synthetic-lm", seq_len=16,
         vocab_size=64, seed=0, deterministic=True))
     ids = jnp.asarray(batch["input_ids"])

@@ -46,7 +46,7 @@ def test_find_free_port():
 
 
 def test_resolve_axis_sizes():
-    # Returns sizes in AXES order: (data, fsdp, sequence, tensor).
+    # Returns sizes in AXES order: (data, fsdp, sequence, tensor, expert).
     assert resolve_axis_sizes(dp=-1, n_devices=8) == (8, 1, 1, 1, 1)
     assert resolve_axis_sizes(dp=2, fsdp=-1, n_devices=8) == (2, 4, 1, 1, 1)
     assert resolve_axis_sizes(dp=2, fsdp=2, tensor=2, n_devices=8) == (2, 2, 1, 2, 1)
