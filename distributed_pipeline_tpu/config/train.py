@@ -82,6 +82,11 @@ class ModelSettings(S):
     moe_experts: int = _(0, "mixture-of-experts: expert count (0 = dense MLPs)")
     moe_top_k: int = _(2, "MoE router top-k")
     moe_every: int = _(2, "MoE replaces the MLP in every k-th block")
+    scan_layers: bool = _(False, "stacked layer weights (lax.scan over "
+                                 "blocks; enables pipeline parallelism and "
+                                 "fast compiles for deep models)")
+    pp_chunks: int = _(4, "GPipe microchunks per per-shard batch "
+                          "(pipeline parallelism; bubble = (S-1)/(chunks+S-1))")
 
 
 class MeshSettings(S):
@@ -94,6 +99,8 @@ class MeshSettings(S):
     tensor: int = _(1, "tensor-parallel axis size")
     sequence: int = _(1, "sequence/context-parallel axis size (ring attention)")
     expert: int = _(1, "expert-parallel axis size (MoE expert sharding)")
+    pipe: int = _(1, "pipeline-parallel axis size (GPipe stage streaming; "
+                     "requires --scan_layers true)")
 
 
 class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):

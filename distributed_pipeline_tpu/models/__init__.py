@@ -113,7 +113,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                              dtype: str = "bfloat16", remat: bool = False,
                              attention_impl: str = "auto",
                              moe_experts: int = 0, moe_top_k: int = 2,
-                             moe_every: int = 2,
+                             moe_every: int = 2, scan_layers: bool = False,
+                             pp_chunks: int = 4,
                              **_unused: Any) -> Workload:
     """Build a :class:`Workload` from (a superset of) ``TrainSettings`` fields
     — callable as ``create_model_from_config(**settings.dict())`` exactly like
@@ -124,6 +125,9 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                          f"available: {sorted(PRESETS)}")
     if moe_experts > 0 and moe_every < 1:
         raise ValueError(f"moe_every must be >= 1, got {moe_every}")
+    if scan_layers and moe_experts > 0:
+        raise ValueError("scan_layers (stacked/pipelined blocks) does not "
+                         "yet compose with MoE; use one or the other")
     preset = PRESETS[model_family].get(model_size)
     if preset is None:
         raise ValueError(f"no preset {model_size!r} for family {model_family!r}; "
@@ -139,7 +143,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
             num_layers=layers, num_heads=heads, emb_dim=DIFFUSEQ_EMB_DIM,
             dtype=jdtype, remat=remat, attention_impl=attention_impl,
             moe_experts=moe_experts, moe_top_k=moe_top_k,
-            moe_every=moe_every)
+            moe_every=moe_every, scan_layers=scan_layers,
+            pp_chunks=pp_chunks)
         schedule = make_schedule(noise_schedule, diffusion_steps)
 
         def compute_losses(params, batch, rng):
@@ -156,7 +161,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
             vocab_size=vocab_size, seq_len=seq_len, hidden_size=hidden,
             num_layers=layers, num_heads=heads, dtype=jdtype, remat=remat,
             attention_impl=attention_impl, moe_experts=moe_experts,
-            moe_top_k=moe_top_k, moe_every=moe_every)
+            moe_top_k=moe_top_k, moe_every=moe_every,
+            scan_layers=scan_layers, pp_chunks=pp_chunks)
 
         def compute_losses(params, batch, rng):
             return gpt2_losses(model, params, batch, rng)

@@ -185,11 +185,29 @@ class TransformerBackbone(nn.Module):
     moe_top_k: int = 2
     moe_every: int = 2  # MoE replaces the MLP in every moe_every-th block
     moe_no_drop: bool = False
+    scan_layers: bool = False  # stacked weights: lax.scan over layers, and
+    # GPipe pipeline streaming when the mesh has a pipe axis > 1
+    pp_chunks: int = 4
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray] = None,
                  cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        if self.scan_layers:
+            if self.decode:
+                raise ValueError(
+                    "scan_layers does not support the KV-cache decode path "
+                    "yet; sampling falls back to full-recompute greedy "
+                    "decoding automatically (models/sampling.py)")
+            from .pipeline import PipelinedBlocks
+            x = PipelinedBlocks(
+                self.num_layers, self.num_heads, x.shape[-1],
+                dtype=self.dtype, causal=self.causal, remat=self.remat,
+                pp_chunks=self.pp_chunks,
+                attention_impl=self.attention_impl,
+                name="blocks")(x, pad_mask)
+            return nn.LayerNorm(dtype=jnp.float32,
+                                name="ln_f")(x).astype(self.dtype)
         block_cls = Block
         if self.remat:
             block_cls = nn.remat(Block, prevent_cse=False,

@@ -61,6 +61,8 @@ class DiffuSeqModel(nn.Module):
     moe_top_k: int = 2
     moe_every: int = 2
     moe_no_drop: bool = False
+    scan_layers: bool = False
+    pp_chunks: int = 4
 
     def setup(self) -> None:
         self.word_emb = nn.Embed(
@@ -88,6 +90,7 @@ class DiffuSeqModel(nn.Module):
             causal=False, attention_impl=self.attention_impl,
             moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
             moe_every=self.moe_every, moe_no_drop=self.moe_no_drop,
+            scan_layers=self.scan_layers, pp_chunks=self.pp_chunks,
             name="backbone")
         self.out_proj = nn.Dense(
             self.emb_dim, kernel_init=nn.with_logical_partitioning(

@@ -41,6 +41,8 @@ class GPT2Model(nn.Module):
     moe_top_k: int = 2
     moe_every: int = 2
     moe_no_drop: bool = False
+    scan_layers: bool = False
+    pp_chunks: int = 4
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
@@ -73,6 +75,8 @@ class GPT2Model(nn.Module):
                                 moe_top_k=self.moe_top_k,
                                 moe_every=self.moe_every,
                                 moe_no_drop=self.moe_no_drop,
+                                scan_layers=self.scan_layers,
+                                pp_chunks=self.pp_chunks,
                                 name="backbone")(h, pad_mask, cache_index)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the
         # HBM traffic of f32; softmax stats go to f32 downstream (ops/xent.py).
@@ -96,7 +100,12 @@ def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
     nll = token_cross_entropy(logits, targets)
     denom = jnp.maximum(loss_mask.sum(), 1.0)
     loss = (nll * loss_mask).sum() / denom
-    out = {"loss": loss, "nll": loss,
+    # Teacher-forced next-token accuracy: the right quality gauge when the
+    # data has irreducible noise (greedy-decode-vs-gold caps out once the
+    # gold draws its first unpredictable token and the histories fork).
+    hit = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+    acc = (hit * loss_mask).sum() / denom
+    out = {"loss": loss, "nll": loss, "acc": acc,
            "ppl": jnp.exp(jnp.minimum(loss, 20.0))}
     if jax.tree_util.tree_leaves(mvars.get("losses", {})):  # static: MoE model
         from .moe import MOE_AUX_WEIGHT, moe_aux_from

@@ -1,0 +1,240 @@
+"""Pipeline parallelism: GPipe-style stage streaming over the ``pipe`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2 — DDP only); this
+exceeds it with the TPU-native formulation, built on the same machinery as
+ring attention (parallel/ring.py): ``shard_map`` + ``lax.ppermute`` +
+``lax.scan``, fully differentiable (reverse-mode sends the cotangents back
+around the reverse permutation automatically).
+
+Design:
+
+* **Stacked layer weights** — :class:`PipelinedBlocks` declares every block
+  parameter once with a leading ``[num_layers]`` axis carrying the
+  ``layers`` logical name, which parallel/sharding.py maps onto the mesh's
+  ``pipe`` axis: stage s holds the contiguous layer slice
+  ``[s*L/S, (s+1)*L/S)``. With ``pipe == 1`` this degrades to a plain
+  ``lax.scan`` over layers — the "scan_layers" mode, which also collapses
+  compile time for deep models (one traced block instead of num_layers).
+* **GPipe schedule** — the per-device batch splits into ``pp_chunks``
+  equal microchunks; at tick t, stage 0 ingests chunk t while stage s
+  applies its layers to the chunk received from stage s-1 and forwards the
+  result via a non-cyclic ``ppermute``. After ``pp_chunks + S - 1`` ticks
+  the last stage holds every output chunk; one masked ``psum`` replicates
+  them back across the pipe axis. Bubble ticks compute on clamped garbage
+  and are masked out of the output — compute stays uniform across devices
+  (SPMD cannot branch per stage).
+* **Composition, v1 scope** — composes with ``data``/``expert`` batch
+  sharding. ``fsdp``/``tensor``/``sequence`` > 1 alongside ``pipe`` > 1 is
+  rejected (weight gathering inside stages and ring-in-stage come later);
+  MoE and KV-cache decode are likewise not yet available in stacked mode
+  (the factory rejects those combinations).
+
+The pure-function block forward here is numerically identical to
+backbone.Block (same pre-LN residual structure, f32 layernorm statistics,
+bf16 matmuls) — pinned by tests/test_pipeline.py's transplant parity test.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops import dot_product_attention
+from .backbone import EMBED, HEADS, KV, MLP, _dense_init
+
+LAYERS = "layers"
+
+__all__ = ["PipelinedBlocks", "block_fwd"]
+
+
+def _layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-6) -> jnp.ndarray:
+    """f32 layernorm matching nn.LayerNorm(dtype=jnp.float32) defaults."""
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
+              pad_mask: Optional[jnp.ndarray], *, num_heads: int,
+              dtype: jnp.dtype, causal: bool,
+              attention_impl: str = "xla") -> jnp.ndarray:
+    """One pre-LN transformer block as a pure function of its param dict
+    (the stacked-per-layer slice) — the math of backbone.Block."""
+    B, L, D = x.shape
+    H = num_heads
+    h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
+    qkv = jnp.einsum("bld,dthk->tbhlk", h, lp["qkv"].astype(dtype))
+    o = dot_product_attention(qkv[0], qkv[1], qkv[2], pad_mask,
+                              causal=causal, impl=attention_impl)
+    x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
+    h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dtype)
+    h = jnp.einsum("bld,dm->blm", h, lp["wi"].astype(dtype))
+    h = nn.gelu(h, approximate=True)
+    return x + jnp.einsum("blm,md->bld", h, lp["wo"].astype(dtype))
+
+
+class PipelinedBlocks(nn.Module):
+    """num_layers pre-LN blocks with stacked weights; sequential layer scan
+    at ``pipe == 1``, GPipe streaming at ``pipe > 1`` (module docstring)."""
+
+    num_layers: int
+    num_heads: int
+    hidden_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    causal: bool = False
+    pp_chunks: int = 4
+    attention_impl: str = "xla"
+    remat: bool = False
+
+    def _impl(self) -> str:
+        # "auto"/"ring" would consult the ambient mesh from inside the
+        # pipeline's shard_map — resolve them to the dense kernel here;
+        # an explicit "pallas"/"xla" choice is honored.
+        return (self.attention_impl
+                if self.attention_impl in ("xla", "pallas") else "xla")
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        Lc, D, H = self.num_layers, self.hidden_size, self.num_heads
+        assert D == x.shape[-1], (D, x.shape)
+        Dh = D // H
+        p = functools.partial(self.param)
+        lp = {
+            "ln1_scale": p("ln1_scale", nn.with_logical_partitioning(
+                nn.initializers.ones, (LAYERS, None)), (Lc, D), jnp.float32),
+            "ln1_bias": p("ln1_bias", nn.with_logical_partitioning(
+                nn.initializers.zeros, (LAYERS, None)), (Lc, D), jnp.float32),
+            "qkv": p("qkv", nn.with_logical_partitioning(
+                _dense_init(D), (LAYERS, EMBED, None, HEADS, KV)),
+                (Lc, D, 3, H, Dh), jnp.float32),
+            "out": p("out", nn.with_logical_partitioning(
+                _dense_init(D), (LAYERS, HEADS, KV, EMBED)),
+                (Lc, H, Dh, D), jnp.float32),
+            "ln2_scale": p("ln2_scale", nn.with_logical_partitioning(
+                nn.initializers.ones, (LAYERS, None)), (Lc, D), jnp.float32),
+            "ln2_bias": p("ln2_bias", nn.with_logical_partitioning(
+                nn.initializers.zeros, (LAYERS, None)), (Lc, D), jnp.float32),
+            "wi": p("wi", nn.with_logical_partitioning(
+                _dense_init(D), (LAYERS, EMBED, MLP)),
+                (Lc, D, 4 * D), jnp.float32),
+            "wo": p("wo", nn.with_logical_partitioning(
+                _dense_init(4 * D), (LAYERS, MLP, EMBED)),
+                (Lc, 4 * D, D), jnp.float32),
+        }
+
+        from ..parallel.ring import current_mesh
+        mesh = current_mesh()
+        S = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if S <= 1 or self.is_initializing():
+            # init traces with a tiny dummy batch that can't be chunked;
+            # param shapes are identical either way.
+            # scan_layers mode: one traced block, sequential over the stack.
+            def layer(h, one):
+                return block_fwd(one, h, pad_mask, num_heads=H,
+                                 dtype=self.dtype, causal=self.causal,
+                                 attention_impl=self._impl()), None
+
+            if self.remat:
+                layer = jax.checkpoint(layer, prevent_cse=False)
+            x, _ = jax.lax.scan(layer, x, lp)
+            return x
+        return self._gpipe(mesh, S, lp, x, pad_mask)
+
+    def _gpipe(self, mesh, S, lp, x, pad_mask):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        for ax in ("fsdp", "tensor", "sequence"):
+            if mesh.shape[ax] > 1:
+                raise ValueError(
+                    f"pipeline parallelism v1 composes with data/expert "
+                    f"axes only; mesh has {ax}={mesh.shape[ax]}")
+        if self.num_layers % S:
+            raise ValueError(f"num_layers {self.num_layers} not divisible "
+                             f"by pipe axis {S}")
+        B = x.shape[0]
+        batch_axes = tuple(a for a in ("data", "expert")
+                           if mesh.shape[a] > 1)
+        n_b = 1
+        for a in batch_axes:
+            n_b *= mesh.shape[a]
+        if B % n_b:
+            # raising beats silently replicating the batch over a dropped
+            # axis (which would hide the misconfiguration as 1/n throughput)
+            raise ValueError(
+                f"global batch {B} not divisible by data x expert axes "
+                f"product {n_b}")
+        M = self.pp_chunks
+        if (B // n_b) % M:
+            raise ValueError(
+                f"per-shard batch {B // n_b} not divisible by pp_chunks {M}")
+        bspec = P(batch_axes or None)
+        pspec = jax.tree_util.tree_map(
+            lambda a: P(*(("pipe",) + (None,) * (a.ndim - 1))), lp)
+        x3 = P(batch_axes or None, None, None)
+        m2 = P(batch_axes or None, None)
+
+        fn = shard_map(
+            functools.partial(self._schedule, M=M),
+            mesh=mesh,
+            in_specs=(pspec, x3, m2),
+            out_specs=x3,
+            check_vma=False)
+        if pad_mask is None:
+            pad_mask = jnp.ones(x.shape[:2], jnp.int32)
+        return fn(lp, x, pad_mask)
+
+    def _schedule(self, lp_local, x_local, mask_local, *, M: int):
+        """Per-device GPipe schedule; lp_local holds THIS stage's layers."""
+        S = jax.lax.psum(1, "pipe")
+        sid = jax.lax.axis_index("pipe")
+        B, L, D = x_local.shape
+        cb = B // M
+        chunks = x_local.reshape(M, cb, L, D)
+        mask_chunks = mask_local.reshape(M, cb, L)
+        perm = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1
+
+        def apply_stage(h, mask):
+            def layer(h, one):
+                return block_fwd(one, h, mask, num_heads=self.num_heads,
+                                 dtype=self.dtype, causal=self.causal,
+                                 attention_impl=self._impl()), None
+
+            if self.remat:
+                layer = jax.checkpoint(layer, prevent_cse=False)
+            h, _ = jax.lax.scan(layer, h, lp_local)
+            return h
+
+        def tick(carry, t):
+            recv, outs = carry
+            # chunk being processed by THIS stage at tick t is chunk t-sid;
+            # its pad mask is input data (replicated over pipe), no permute.
+            cidx = jnp.clip(t - sid, 0, M - 1)
+            inp = jnp.where(sid == 0, chunks[jnp.clip(t, 0, M - 1)], recv)
+            out = apply_stage(inp, mask_chunks[cidx])
+            recv_next = jax.lax.ppermute(out, "pipe", perm)
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            live = jnp.logical_and(t >= S - 1, jnp.equal(sid, S - 1))
+            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
+                                                keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(live, out, prev), oidx, 0)
+            return (recv_next, outs), None
+
+        outs0 = jnp.zeros((M, cb, L, D), x_local.dtype)
+        (_, outs), _ = jax.lax.scan(
+            tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0),
+            jnp.arange(M + S - 1))
+        # Outputs live on the last stage; replicate them across the pipe
+        # axis with one masked all-reduce.
+        outs = jax.lax.psum(
+            jnp.where(jnp.equal(jax.lax.axis_index("pipe"), S - 1), outs,
+                      jnp.zeros_like(outs)), "pipe")
+        return outs.reshape(B, L, D)

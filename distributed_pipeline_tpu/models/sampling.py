@@ -127,6 +127,10 @@ def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
     B, L = ids.shape
     pad = jnp.ones_like(ids)
 
+    if getattr(model, "scan_layers", False):
+        use_cache = False  # stacked blocks have no KV-cache path yet;
+        # full-recompute greedy is identical output, just O(L^2) per token
+
     if not use_cache:
         def body(i, ids):
             logits = model.apply(params, ids, pad)        # [B, L, V]

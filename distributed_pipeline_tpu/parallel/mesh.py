@@ -15,6 +15,9 @@ with named axes that the rest of the framework shards against:
                  dense layers, MoE expert weights shard over it, and XLA
                  emits the dispatch/combine all-to-alls from the einsum
                  shardings — models/moe.py)
+* ``pipe``     — pipeline parallelism (GPipe-style: stacked layer weights
+                 shard over it, activations stream stage-to-stage via
+                 ``ppermute`` — models/pipeline.py)
 
 Axis sizes come from ``MeshSettings`` (config/train.py); ``-1`` means "all
 remaining devices". Multi-host meshes use ``mesh_utils.create_device_mesh``
@@ -32,18 +35,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["AXES", "make_mesh", "resolve_axis_sizes", "batch_spec", "local_mesh_info"]
 
-AXES: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor", "expert")
+AXES: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor", "expert",
+                         "pipe")
 
 
 def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
-                       tensor: int = 1, expert: int = 1,
+                       tensor: int = 1, expert: int = 1, pipe: int = 1,
                        n_devices: Optional[int] = None) -> Tuple[int, ...]:
     """Resolve ``-1`` axis sizes against the device count and validate the
     product. Returns sizes in AXES order (data, fsdp, sequence, tensor,
-    expert)."""
+    expert, pipe)."""
     n = n_devices if n_devices is not None else jax.device_count()
     sizes = {"data": dp, "fsdp": fsdp, "sequence": sequence, "tensor": tensor,
-             "expert": expert}
+             "expert": expert, "pipe": pipe}
     unknown = [k for k, v in sizes.items() if v == -1]
     if len(unknown) > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
@@ -61,7 +65,7 @@ def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
 
 
 def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
-              expert: int = 1,
+              expert: int = 1, pipe: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the framework mesh. Works for 1 device (all axes size 1 except
     one) through multi-host pods; on real TPU slices
@@ -70,7 +74,8 @@ def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
         devices = jax.devices()
     n = len(devices)
     shape = resolve_axis_sizes(dp=dp, fsdp=fsdp, sequence=sequence,
-                               tensor=tensor, expert=expert, n_devices=n)
+                               tensor=tensor, expert=expert, pipe=pipe,
+                               n_devices=n)
     try:
         from jax.experimental import mesh_utils
         device_array = mesh_utils.create_device_mesh(shape, devices=list(devices))

@@ -41,6 +41,8 @@ LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     # the dispatch/combine einsums against batch-sharded activations make
     # XLA emit the all-to-alls (GShard recipe).
     ("expert", "expert"),
+    # Stacked-layer leading dim -> pipeline stages (models/pipeline.py).
+    ("layers", "pipe"),
 )
 
 

@@ -77,9 +77,14 @@ def main(namespace: argparse.Namespace) -> None:
     eval_data = load_data_from_args(
         "valid", **{**args.dict(), "deterministic": True})
 
+    if args.pipe > 1 and not args.scan_layers:
+        raise SystemExit("--pipe > 1 requires --scan_layers true (stacked "
+                         "layer weights are what shard into pipeline "
+                         "stages); without it the pipe axis would only "
+                         "replicate work")
     workload = create_model_from_config(**args.dict())
     mesh = make_mesh(dp=args.dp, fsdp=args.fsdp, sequence=args.sequence,
-                     tensor=args.tensor, expert=args.expert)
+                     tensor=args.tensor, expert=args.expert, pipe=args.pipe)
     logger.info(local_mesh_info(mesh))
 
     if rank == 0:  # args snapshot for reproducibility (train.py:82-87)

@@ -46,12 +46,14 @@ def test_find_free_port():
 
 
 def test_resolve_axis_sizes():
-    # Returns sizes in AXES order: (data, fsdp, sequence, tensor, expert).
-    assert resolve_axis_sizes(dp=-1, n_devices=8) == (8, 1, 1, 1, 1)
-    assert resolve_axis_sizes(dp=2, fsdp=-1, n_devices=8) == (2, 4, 1, 1, 1)
-    assert resolve_axis_sizes(dp=2, fsdp=2, tensor=2, n_devices=8) == (2, 2, 1, 2, 1)
-    assert resolve_axis_sizes(dp=2, fsdp=2, sequence=2, n_devices=8) == (2, 2, 2, 1, 1)
-    assert resolve_axis_sizes(dp=2, fsdp=2, expert=2, n_devices=8) == (2, 2, 1, 1, 2)
+    # Returns sizes in AXES order: (data, fsdp, sequence, tensor, expert,
+    # pipe).
+    assert resolve_axis_sizes(dp=-1, n_devices=8) == (8, 1, 1, 1, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=-1, n_devices=8) == (2, 4, 1, 1, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=2, tensor=2, n_devices=8) == (2, 2, 1, 2, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=2, sequence=2, n_devices=8) == (2, 2, 2, 1, 1, 1)
+    assert resolve_axis_sizes(dp=2, fsdp=2, expert=2, n_devices=8) == (2, 2, 1, 1, 2, 1)
+    assert resolve_axis_sizes(dp=2, pipe=4, n_devices=8) == (2, 1, 1, 1, 1, 4)
     with pytest.raises(ValueError):
         resolve_axis_sizes(dp=3, n_devices=8)
     with pytest.raises(ValueError):
@@ -66,7 +68,7 @@ def test_make_mesh_shapes(axes):
     mesh = make_mesh(**axes)
     assert mesh.devices.size == 8
     assert set(mesh.shape.keys()) == {"data", "fsdp", "sequence", "tensor",
-                                      "expert"}
+                                      "expert", "pipe"}
 
 
 def test_mesh_psum_rides_sharding():
