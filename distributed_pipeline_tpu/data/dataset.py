@@ -19,12 +19,13 @@ shapes only — padding to ``seq_len`` keeps XLA from recompiling.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
+
+from .tokenizer import stable_hash_id
 
 __all__ = [
     "SyntheticSeq2SeqDataset",
@@ -177,9 +178,7 @@ class WordVocab:
             if self.token_to_id is not None:
                 out.append(self.token_to_id.get(tok, N_RESERVED))
             else:
-                h = int.from_bytes(
-                    hashlib.blake2s(tok.encode(), digest_size=8).digest(), "little")
-                out.append(N_RESERVED + h % (self.vocab_size - N_RESERVED))
+                out.append(stable_hash_id(tok, self.vocab_size))
         return out
 
 

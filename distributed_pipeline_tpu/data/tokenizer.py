@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import warnings
 from collections import Counter
 from typing import Dict, Iterable, List, Tuple
 
@@ -34,7 +35,20 @@ N_RESERVED = 4  # PAD/BOS/EOS/SEP, data/dataset.py
 
 EOW = "</w>"  # end-of-word marker symbol
 
-__all__ = ["train_bpe", "BPEVocab", "EOW"]
+__all__ = ["train_bpe", "BPEVocab", "EOW", "stable_hash_id"]
+
+
+def stable_hash_id(token: str, vocab_size: int,
+                   n_reserved: int = N_RESERVED) -> int:
+    """The ONE stable out-of-vocabulary hash: blake2s-64 little-endian into
+    ``[n_reserved, vocab_size)``. Deterministic across hosts, runs, and
+    Python hash randomization. Every OOV fallback (word-level, BPE, and the
+    native C++ encoder's sentinel resolution) must route through here — two
+    drifting copies would silently produce diverging token ids between
+    machines."""
+    h = int.from_bytes(
+        hashlib.blake2s(token.encode(), digest_size=8).digest(), "little")
+    return n_reserved + h % (vocab_size - n_reserved)
 
 
 def train_bpe(texts: Iterable[str], vocab_size: int,
@@ -100,6 +114,24 @@ class BPEVocab:
                 f"--vocab_size")
         self.ranks: Dict[Tuple[str, str], int] = {
             tuple(m): i for i, m in enumerate(artifact["merges"])}
+        # The per-word merge loop is the input pipeline's host-side hot
+        # spot; prefer the C++ encoder (native/bpe_encoder.cpp, exact same
+        # contract) and keep this Python path as the portable fallback.
+        # No compiler on the box is normal and stays silent; a loaded
+        # library that then fails is a bug worth one loud warning (a silent
+        # ~15x tokenization slowdown is otherwise undiagnosable).
+        self._native = None
+        from ..native import NativeBPE, load_library
+        if load_library() is not None:
+            try:
+                self._native = NativeBPE(
+                    [list(m) for m in artifact["merges"]], self.token_to_id,
+                    vocab_size, N_RESERVED)
+            except Exception as e:
+                warnings.warn(
+                    f"native BPE library loaded but encoder init failed "
+                    f"({e!r}); tokenizing in pure Python")
+                self._native = None
 
     @classmethod
     def load(cls, path: str, vocab_size: int) -> "BPEVocab":
@@ -125,14 +157,20 @@ class BPEVocab:
             return got
         # out-of-alphabet symbol: stable hash into the id space (same
         # fallback contract as WordVocab's hashing mode)
-        h = int.from_bytes(
-            hashlib.blake2s(symbol.encode(), digest_size=8).digest(),
-            "little")
-        return N_RESERVED + h % (self.vocab_size - N_RESERVED)
+        return stable_hash_id(symbol, self.vocab_size)
 
     def encode(self, text: str) -> List[int]:
+        words = text.split()
+        if self._native is not None:
+            try:
+                return self._native.encode_words(words)
+            except Exception as e:
+                warnings.warn(
+                    f"native BPE encode failed ({e!r}); degrading to the "
+                    f"pure-Python tokenizer for the rest of the process")
+                self._native = None
         out: List[int] = []
-        for word in text.split():
+        for word in words:
             out.extend(self._id(s) for s in self._bpe_word(word))
         return out
 

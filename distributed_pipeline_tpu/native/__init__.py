@@ -1,0 +1,206 @@
+"""Native (C++) runtime components, consumed via ``ctypes``.
+
+The reference keeps all native capability in external libraries (SURVEY.md
+§2.1); here the host-side data path gets its own native piece: a C++ BPE
+encoder (``bpe_encoder.cpp``) behind the exact contract of
+``data/tokenizer.py:BPEVocab``. The library is built on first use with the
+toolchain baked into the image (``g++``; no pybind11, so the binding is a
+plain C ABI + ctypes) and cached next to the source. Everything degrades
+gracefully: no compiler, a failed build, or ``DPT_NATIVE=0`` simply leaves
+the pure-Python encoder in charge — the same degrade-to-portable contract
+the distributed substrate follows (parallel/dist.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["load_library", "NativeBPE", "native_enabled"]
+
+_SRC = os.path.join(os.path.dirname(__file__), "bpe_encoder.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_SO = os.path.join(_BUILD_DIR, "libdpt_bpe.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_hash_fn = None
+
+
+def _stable_hash_id():
+    """The shared OOV hash from data.tokenizer (the parity contract),
+    imported lazily once — tokenizer imports this package inside
+    ``BPEVocab.__init__``, so a module-level import here would be a cycle
+    hazard; per-call imports would tax OOV-heavy corpora."""
+    global _hash_fn
+    if _hash_fn is None:
+        from ..data.tokenizer import stable_hash_id
+        _hash_fn = stable_hash_id
+    return _hash_fn
+
+
+def native_enabled() -> bool:
+    """False when the user opted out via ``DPT_NATIVE=0``."""
+    return os.environ.get("DPT_NATIVE", "1") not in ("0", "false", "False")
+
+
+def _build() -> bool:
+    """Compile the shared library if missing or stale; True on success.
+
+    Staleness is mtime-based so editing the .cpp during development
+    rebuilds. The compile lands in a temp file first and is moved into
+    place atomically — concurrent processes (e.g. a ``--nprocs`` dev ring)
+    race benignly. Compiler: ``$CXX`` if set (same knob as the Makefile),
+    else the first of g++/clang++ on PATH."""
+    try:
+        if os.path.exists(_SO) and (
+                not os.path.exists(_SRC)  # prebuilt .so shipped without src
+                or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return True
+        if not os.path.exists(_SRC):
+            return False
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        env_cxx = os.environ.get("CXX")
+        compilers = [env_cxx] if env_cxx else ["g++", "clang++"]
+        for cxx in compilers:
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+            os.close(fd)
+            try:
+                proc = subprocess.run(
+                    [cxx, "-O2", "-std=c++17", "-Wall", "-Wextra",
+                     "-shared", "-fPIC", "-o", tmp, _SRC],
+                    capture_output=True, text=True, timeout=120)
+                if proc.returncode == 0:
+                    os.replace(tmp, _SO)
+                    return True
+            except (OSError, subprocess.SubprocessError):
+                continue
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        return False
+    except OSError:
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The process-wide handle to the native library, building it on first
+    use; None when native is disabled or unavailable (callers fall back to
+    Python)."""
+    global _lib, _lib_failed
+    if not native_enabled():
+        return None
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.dpt_bpe_create.restype = ctypes.c_void_p
+        lib.dpt_bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.dpt_bpe_destroy.restype = None
+        lib.dpt_bpe_destroy.argtypes = [ctypes.c_void_p]
+        lib.dpt_bpe_encode.restype = ctypes.c_int64
+        lib.dpt_bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+        lib.dpt_bpe_oov_count.restype = ctypes.c_int64
+        lib.dpt_bpe_oov_count.argtypes = [ctypes.c_void_p]
+        lib.dpt_bpe_oov_get.restype = ctypes.c_int64
+        lib.dpt_bpe_oov_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def _pack_tables(merges: List[List[str]], vocab: Dict[str, int]) -> bytes:
+    """Serialize the BPE artifact into the C++ wire format (see
+    bpe_encoder.cpp header): length-prefixed UTF-8 strings, no JSON parsing
+    on the native side."""
+    parts = [struct.pack("<II", 0x45504254, 1), struct.pack("<I", len(merges))]
+    for a, b in merges:
+        ab, bb = a.encode(), b.encode()
+        parts.append(struct.pack("<I", len(ab)) + ab)
+        parts.append(struct.pack("<I", len(bb)) + bb)
+    parts.append(struct.pack("<I", len(vocab)))
+    for s, i in vocab.items():
+        sb = s.encode()
+        parts.append(struct.pack("<I", len(sb)) + sb + struct.pack("<i", i))
+    return b"".join(parts)
+
+
+class NativeBPE:
+    """ctypes wrapper around one C++ encoder instance.
+
+    ``encode_words`` takes the words of one text (the caller keeps Python's
+    ``str.split()`` Unicode-whitespace semantics) and returns ids identical
+    to ``BPEVocab.encode``: vocab hits come from C++, out-of-alphabet
+    symbols come back as sentinels and are resolved here with the same
+    blake2s stable hash the Python path uses."""
+
+    def __init__(self, merges: List[List[str]], vocab: Dict[str, int],
+                 vocab_size: int, n_reserved: int):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native BPE library unavailable")
+        blob = _pack_tables(merges, vocab)
+        handle = lib.dpt_bpe_create(blob, len(blob))
+        if not handle:
+            raise RuntimeError("native BPE rejected the vocab tables")
+        self._lib = lib
+        self._handle = handle
+        self._vocab_size = vocab_size
+        self._n_reserved = n_reserved
+        self._buf_cap = 4096
+        self._buf = (ctypes.c_int32 * self._buf_cap)()
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.dpt_bpe_destroy(handle)
+            self._handle = None
+
+    def _resolve_oov(self, k: int) -> int:
+        cap = 64
+        while True:
+            raw = (ctypes.c_uint8 * cap)()
+            n = self._lib.dpt_bpe_oov_get(self._handle, k, raw, cap)
+            if n < 0:
+                raise RuntimeError(f"native BPE: bad OOV index {k}")
+            if n <= cap:
+                break
+            cap = int(n)
+        symbol = bytes(raw[:n]).decode()
+        return _stable_hash_id()(symbol, self._vocab_size, self._n_reserved)
+
+    def encode_words(self, words: List[str]) -> List[int]:
+        if not words:
+            return []
+        text = "\n".join(words).encode()
+        with self._lock:
+            n = self._lib.dpt_bpe_encode(self._handle, text, len(text),
+                                         self._buf, self._buf_cap)
+            if n > self._buf_cap:
+                self._buf_cap = int(n)
+                self._buf = (ctypes.c_int32 * self._buf_cap)()
+                n = self._lib.dpt_bpe_encode(self._handle, text, len(text),
+                                             self._buf, self._buf_cap)
+            # OOV sentinels must be resolved before the NEXT encode on this
+            # handle (which may flush the C++ memo/OOV tables when the
+            # bounded word cache overflows) — so resolve under the lock.
+            return [i if i >= 0 else self._resolve_oov(-i - 1)
+                    for i in self._buf[:n]]

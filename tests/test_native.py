@@ -1,0 +1,145 @@
+"""Native C++ BPE encoder (distributed_pipeline_tpu/native): build, exact
+parity with the pure-Python path, fallback behavior.
+
+The contract under test: ``BPEVocab.encode`` must return byte-identical ids
+whether the native library carried the merge loop or the Python fallback
+did (native/bpe_encoder.cpp mirrors ``_bpe_word``/``_id`` including the
+blake2s OOV hash, resolved on the Python side from OOV sentinels)."""
+
+import os
+import random
+import string
+
+import pytest
+
+from distributed_pipeline_tpu.data.tokenizer import BPEVocab, train_bpe
+from distributed_pipeline_tpu.native import load_library, native_enabled
+
+
+def _python_encode(vocab: BPEVocab, text: str):
+    out = []
+    for word in text.split():
+        out.extend(vocab._id(s) for s in vocab._bpe_word(word))
+    return out
+
+
+def _artifact():
+    texts = ["the quick brown fox jumps over the lazy dog",
+             "pack my box with five dozen liquor jugs",
+             "the boxes were packed with quick jumps"] * 20
+    return train_bpe(texts, vocab_size=96)
+
+
+needs_native = pytest.mark.skipif(
+    load_library() is None, reason="no g++ / native build unavailable")
+
+
+@needs_native
+def test_native_library_builds():
+    assert native_enabled()
+    assert load_library() is not None
+
+
+@needs_native
+def test_native_matches_python_on_training_corpus():
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is not None, "native path should be active"
+    for text in ["the quick brown fox", "packed boxes jump",
+                 "", "   ", "dog"]:
+        assert vocab.encode(text) == _python_encode(vocab, text)
+
+
+@needs_native
+def test_native_matches_python_on_oov_and_unicode():
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is not None
+    cases = [
+        "zebra xylophone quartz",            # OOV characters -> hash path
+        "naïve café über straße",           # multi-byte code points
+        "日本語 テスト",                      # CJK, fully out of alphabet
+        "mixed日本quick語fox",               # interleaved
+        "a b c",                  # Unicode whitespace split
+        "étude é",              # combining marks
+    ]
+    for text in cases:
+        assert vocab.encode(text) == _python_encode(vocab, text), text
+
+
+@needs_native
+def test_native_matches_python_randomized():
+    rng = random.Random(7)
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is not None
+    alphabet = string.ascii_lowercase + "  éß日"
+    for _ in range(200):
+        text = "".join(rng.choice(alphabet)
+                       for _ in range(rng.randrange(0, 80)))
+        assert vocab.encode(text) == _python_encode(vocab, text), repr(text)
+
+
+@needs_native
+def test_native_repeated_calls_reuse_oov_table():
+    # Repeated encodes of the same OOV-bearing text must stay stable (the
+    # sentinels are re-resolved per call against the current C++ table).
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is not None
+    first = vocab.encode("zzz qqq zzz")
+    for _ in range(3):
+        assert vocab.encode("zzz qqq zzz") == first
+
+
+@needs_native
+def test_native_cache_flush_keeps_parity():
+    # The C++ word cache is bounded (kWordCacheCap = 65536); overflowing it
+    # flushes the memo AND OOV tables between encode calls. Parity must
+    # survive the flush, including OOV hashing on both sides of it.
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is not None
+    rng = random.Random(11)
+    before = "zyx wvu 日本"  # OOV-heavy probe
+    assert vocab.encode(before) == _python_encode(vocab, before)
+    # ~70k distinct words in large batches to trip the flush cheaply
+    for start in range(0, 70_000, 10_000):
+        text = " ".join(f"w{start + i}x" for i in range(10_000))
+        vocab.encode(text)
+    after = vocab.encode(before)
+    assert after == _python_encode(vocab, before)
+
+
+@needs_native
+def test_native_large_text_grows_buffer():
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is not None
+    text = " ".join(["the quick brown fox"] * 2000)  # > initial 4096 ids
+    assert vocab.encode(text) == _python_encode(vocab, text)
+
+
+def test_env_opt_out_disables_native(monkeypatch):
+    monkeypatch.setenv("DPT_NATIVE", "0")
+    vocab = BPEVocab(_artifact(), vocab_size=96)
+    assert vocab._native is None
+    # and the Python path still works
+    assert vocab.encode("the quick fox") == _python_encode(
+        vocab, "the quick fox")
+
+
+@needs_native
+def test_jsonl_dataset_uses_native(tmp_path):
+    # End-to-end: a jsonl corpus with a trained bpe.json tokenizes through
+    # the native encoder inside JsonlSeq2SeqDataset.
+    import json
+
+    from distributed_pipeline_tpu.data.dataset import JsonlSeq2SeqDataset
+
+    rows = [{"src": "the quick brown fox", "trg": "jumps over the dog"},
+            {"src": "pack my box", "trg": "five dozen jugs"}]
+    with open(tmp_path / "train.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    with open(tmp_path / "bpe.json", "w") as f:
+        json.dump(_artifact(), f)
+    ds = JsonlSeq2SeqDataset(str(tmp_path), "train", seq_len=32,
+                             vocab_size=96)
+    assert ds.vocab._bpe is not None and ds.vocab._bpe._native is not None
+    item = ds[0]
+    assert item["input_ids"].shape == (32,)
