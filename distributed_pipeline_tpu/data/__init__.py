@@ -69,9 +69,17 @@ def infinite_loader_from_iterable(it: Iterable) -> Iterator:
 
 def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
                        process_index: int, process_count: int,
-                       loop: bool) -> Iterator[int]:
+                       loop: bool, skip_items: int = 0) -> Iterator[int]:
     """Yield this host's slice of the (optionally shuffled) global index
-    sequence; epochs reshuffle with a different fold of the seed."""
+    sequence; epochs reshuffle with a different fold of the seed.
+
+    ``skip_items`` fast-forwards the stream by that many items in O(1):
+    the order is a pure function of (seed, epoch), so whole epochs are
+    jumped arithmetically and only the first yielded epoch is sliced —
+    this is what makes checkpoint resume replay the EXACT data order an
+    uninterrupted run would have seen (the reference restarts its
+    DataLoader from scratch on resume, silently repeating early batches).
+    """
     # Every host must yield the SAME number of items per epoch, or multi-host
     # collectives desync (host 0's stride can be 1 longer): trim to the floor.
     per_host = n_items // process_count
@@ -79,7 +87,10 @@ def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
         raise ValueError(
             f"dataset of {n_items} items cannot feed {process_count} hosts "
             f"(at least one item per host per epoch required)")
-    epoch = 0
+    epoch = skip_items // per_host
+    offset = skip_items % per_host
+    if not loop and epoch > 0:
+        return  # skipped past the single epoch
     while True:
         if shuffle:
             order = np.random.default_rng(
@@ -87,7 +98,9 @@ def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
             ).permutation(n_items)
         else:
             order = np.arange(n_items)
-        yield from order[process_index::process_count][:per_host].tolist()
+        sl = order[process_index::process_count][:per_host]
+        yield from sl[offset:].tolist()
+        offset = 0
         if not loop:
             return
         epoch += 1
@@ -96,10 +109,12 @@ def _host_index_stream(n_items: int, *, shuffle: bool, seed: int,
 def batch_iterator(dataset: Any, batch_size: int, *, shuffle: bool = True,
                    seed: int = 0, loop: bool = True,
                    process_index: int = 0, process_count: int = 1,
-                   num_workers: int = 0,
-                   prefetch: int = 4) -> Iterator[Dict[str, np.ndarray]]:
+                   num_workers: int = 0, prefetch: int = 4,
+                   skip_batches: int = 0) -> Iterator[Dict[str, np.ndarray]]:
     """Assemble fixed-shape batches from any ``__len__``/``__getitem__``
-    dataset, host-sharded and optionally thread-prefetched."""
+    dataset, host-sharded and optionally thread-prefetched. ``skip_batches``
+    fast-forwards past that many already-consumed batches in O(1) (exact
+    data-order resume; see ``_host_index_stream``)."""
     n = len(dataset)
     if n < batch_size * process_count and not loop:
         raise ValueError(
@@ -114,8 +129,11 @@ def batch_iterator(dataset: Any, batch_size: int, *, shuffle: bool = True,
         """
         idx_stream = _host_index_stream(
             n, shuffle=shuffle, seed=seed, process_index=process_index,
-            process_count=process_count, loop=loop)
-        b = 0
+            process_count=process_count, loop=loop,
+            skip_items=skip_batches * batch_size)
+        # b continues from the global batch counter so the worker-stride
+        # assignment (b % stride) stays identical to an unskipped stream.
+        b = skip_batches
         while True:
             mine = b % stride == worker_id
             taken = 0
@@ -135,16 +153,21 @@ def batch_iterator(dataset: Any, batch_size: int, *, shuffle: bool = True,
 
     if num_workers <= 0:
         return gen()
-    return _prefetched(gen, num_workers=num_workers, depth=prefetch)
+    return _prefetched(gen, num_workers=num_workers, depth=prefetch,
+                       start_batch=skip_batches)
 
 
-def _prefetched(gen_factory, *, num_workers: int, depth: int) -> Iterator:
+def _prefetched(gen_factory, *, num_workers: int, depth: int,
+                start_batch: int = 0) -> Iterator:
     """Run ``num_workers`` producer threads, each materializing its
     ``worker_id :: num_workers`` stripe of the batch sequence (the role of
     torch's ``num_workers`` processes — threads suffice here because item
     synthesis is released-GIL numpy). The consumer round-robins the
     per-worker queues, so the delivered order is identical to the
-    single-producer stream regardless of thread scheduling.
+    single-producer stream regardless of thread scheduling. ``start_batch``
+    is the global index of the first batch the producers will emit (a
+    resumed stream): the round-robin must start at that worker's queue or
+    every delivery is rotated by ``start_batch % num_workers``.
     """
     _END = object()
     stop = threading.Event()
@@ -175,7 +198,7 @@ def _prefetched(gen_factory, *, num_workers: int, depth: int) -> Iterator:
     for wid in range(num_workers):
         threading.Thread(target=worker, args=(wid,), daemon=True).start()
     try:
-        b = 0
+        b = start_batch
         while True:
             item = queues[b % num_workers].get()
             if item is _END:
@@ -212,7 +235,7 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
                         *, dataset: str = "synthetic-seq2seq",
                         seq_len: int = 128, vocab_size: int = 8192,
                         seed: int = 0, data_loader_workers: int = 0,
-                        host_sharded: bool = True,
+                        host_sharded: bool = True, skip_batches: int = 0,
                         **_unused: Any) -> Iterator[Dict[str, np.ndarray]]:
     """The reference's loader entry point (``data/__init__.py:1-27``), with
     identical call semantics: ``deterministic`` disables shuffling (used for
@@ -223,7 +246,10 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
     ``batch_size`` is per host; global batch = ``batch_size * process_count``.
     ``host_sharded=False`` gives every host the SAME stream (required when a
     batch feeds a collective computation as a replicated array — e.g. the
-    eval-decode callback — where per-host divergence would be silent UB)."""
+    eval-decode callback — where per-host divergence would be silent UB).
+    ``skip_batches`` fast-forwards the stream in O(1) so a resumed run sees
+    the exact batches an uninterrupted one would have (run/train.py passes
+    the resume step; one train step consumes one batch)."""
     import jax
 
     ds = _build_dataset(dataset, data_dir, split, seq_len=seq_len,
@@ -236,4 +262,5 @@ def load_data_from_args(split: str = "train", data_dir: str = "",
         process_index=jax.process_index() if host_sharded else 0,
         process_count=jax.process_count() if host_sharded else 1,
         num_workers=max(num_loader_proc, data_loader_workers),
+        skip_batches=skip_batches,
     )

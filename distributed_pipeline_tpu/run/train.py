@@ -73,9 +73,22 @@ def main(namespace: argparse.Namespace) -> None:
                      comm=logger.distributed_mean_comm())
     seed_all(args.seed)
 
-    data = load_data_from_args("train", **args.dict())
+    # Exact-resume data order: find the step this run will resume from
+    # (same discovery TrainLoop does) and fast-forward both streams so the
+    # continued run consumes the batches the uninterrupted one would have
+    # — together with the step-derived train RNG this makes a resumed run
+    # bit-identical. One train step eats one train batch; eval eats one
+    # batch per eval_interval steps.
+    from ..utils.checkpoint import resume_step as _resume_step
+    resume_step = _resume_step(ckpt_path, args.resume_checkpoint)
+    if resume_step and rank == 0:
+        logger.info(f"fast-forwarding data stream past {resume_step} "
+                    f"consumed batches (exact-order resume)")
+    data = load_data_from_args("train", skip_batches=resume_step,
+                               **args.dict())
     eval_data = load_data_from_args(
-        "valid", **{**args.dict(), "deterministic": True})
+        "valid", skip_batches=resume_step // max(args.eval_interval, 1),
+        **{**args.dict(), "deterministic": True})
 
     if args.pipe > 1 and not args.scan_layers:
         raise SystemExit("--pipe > 1 requires --scan_layers true (stacked "

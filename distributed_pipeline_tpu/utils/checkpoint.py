@@ -30,6 +30,7 @@ import orbax.checkpoint as ocp
 
 __all__ = [
     "save_checkpoint", "restore_checkpoint", "restore_resume_state",
+    "resume_step",
     "parse_step_from_name", "find_resume_checkpoint", "find_ema_checkpoint",
     "find_opt_checkpoint", "latest_step",
 ]
@@ -70,6 +71,19 @@ def find_resume_checkpoint(directory: str) -> Optional[str]:
     ``find_resume_checkpoint`` trainer.py:329-335 scans the logger dir)."""
     found = _scan(directory, "model_")
     return found[-1][1] if found else None
+
+
+def resume_step(directory: str, explicit_model_path: str = "") -> int:
+    """The step a run over ``directory`` will resume from, 0 when fresh —
+    the ONE discovery rule (explicit path wins, else newest ``model_*``,
+    step parsed from the name). ``restore_resume_state`` and the data
+    fast-forward in run/train.py both derive from this; keeping them on
+    one code path is what guarantees the stream skip matches the restored
+    step (exact-order resume)."""
+    path = explicit_model_path or find_resume_checkpoint(directory)
+    if not path:
+        return 0
+    return parse_step_from_name(path) or 0
 
 
 def find_ema_checkpoint(directory: str, step: int, rate: str) -> Optional[str]:
@@ -145,7 +159,7 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
         model_path = find_resume_checkpoint(directory)
         if not model_path:
             return None
-    step = parse_step_from_name(model_path) or 0
+    step = resume_step(directory, explicit_model_path)
     params = restore_checkpoint(model_path, abstract_params)
     out: Dict[str, Any] = {"step": step, "params": params, "ema": {},
                            "opt_state": None}
