@@ -159,7 +159,9 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
         model_path = find_resume_checkpoint(directory)
         if not model_path:
             return None
-    step = resume_step(directory, explicit_model_path)
+    # Parse the step from the path actually being restored (never re-scan:
+    # a checkpoint finalized between two scans would desync step and params).
+    step = parse_step_from_name(model_path) or 0
     params = restore_checkpoint(model_path, abstract_params)
     out: Dict[str, Any] = {"step": step, "params": params, "ema": {},
                            "opt_state": None}
