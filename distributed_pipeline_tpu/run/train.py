@@ -79,8 +79,9 @@ def main(namespace: argparse.Namespace) -> None:
     # — together with the step-derived train RNG this makes a resumed run
     # bit-identical. One train step eats one train batch; eval eats one
     # batch per eval_interval steps.
-    from ..utils.checkpoint import resume_step as _resume_step
-    resume_step = _resume_step(ckpt_path, args.resume_checkpoint)
+    from ..utils.checkpoint import resume_target
+    resume_step, resume_path = resume_target(ckpt_path,
+                                             args.resume_checkpoint)
     if resume_step and rank == 0:
         logger.info(f"fast-forwarding data stream past {resume_step} "
                     f"consumed batches (exact-order resume)")
@@ -144,7 +145,9 @@ def main(namespace: argparse.Namespace) -> None:
         log_interval=args.log_interval,
         eval_interval=args.eval_interval,
         save_interval=args.save_interval,
-        resume_checkpoint=args.resume_checkpoint,
+        # The path resolved above, not args.resume_checkpoint: one discovery,
+        # so the stream fast-forward and the restored state cannot desync.
+        resume_checkpoint=resume_path,
         gradient_clipping=args.gradient_clipping,
         weight_decay=args.weight_decay,
         learning_steps=args.learning_steps,

@@ -30,7 +30,7 @@ import orbax.checkpoint as ocp
 
 __all__ = [
     "save_checkpoint", "restore_checkpoint", "restore_resume_state",
-    "resume_step",
+    "resume_target",
     "parse_step_from_name", "find_resume_checkpoint", "find_ema_checkpoint",
     "find_opt_checkpoint", "latest_step",
 ]
@@ -73,17 +73,19 @@ def find_resume_checkpoint(directory: str) -> Optional[str]:
     return found[-1][1] if found else None
 
 
-def resume_step(directory: str, explicit_model_path: str = "") -> int:
-    """The step a run over ``directory`` will resume from, 0 when fresh —
-    the ONE discovery rule (explicit path wins, else newest ``model_*``,
-    step parsed from the name). ``restore_resume_state`` and the data
-    fast-forward in run/train.py both derive from this; keeping them on
-    one code path is what guarantees the stream skip matches the restored
-    step (exact-order resume)."""
+def resume_target(directory: str,
+                  explicit_model_path: str = "") -> Tuple[int, str]:
+    """``(step, model_path)`` a run over ``directory`` will resume from —
+    ``(0, "")`` when fresh. The ONE discovery rule (explicit path wins,
+    else newest ``model_*``, step parsed from the name). run/train.py
+    resolves this ONCE and hands the path to TrainLoop as the explicit
+    resume target, so the data-stream fast-forward and the restored state
+    cannot desync even if another checkpoint lands mid-setup (exact-order
+    resume)."""
     path = explicit_model_path or find_resume_checkpoint(directory)
     if not path:
-        return 0
-    return parse_step_from_name(path) or 0
+        return 0, ""
+    return parse_step_from_name(path) or 0, path
 
 
 def find_ema_checkpoint(directory: str, step: int, rate: str) -> Optional[str]:
