@@ -64,20 +64,58 @@ def resolve_axis_sizes(dp: int = -1, fsdp: int = 1, sequence: int = 1,
     return tuple(sizes[a] for a in AXES)  # type: ignore[return-value]
 
 
+def _slice_count(devices: Sequence[jax.Device]) -> int:
+    """Number of distinct TPU slices among ``devices`` (1 when the backend
+    doesn't report ``slice_index`` — CPU, GPU, single slice)."""
+    ids = set()
+    for d in devices:
+        try:
+            s = getattr(d, "slice_index", None)
+        except RuntimeError:  # some backends raise instead of returning None
+            return 1
+        if s is None:
+            return 1
+        ids.add(s)
+    return max(len(ids), 1)
+
+
 def make_mesh(dp: int = -1, fsdp: int = 1, sequence: int = 1, tensor: int = 1,
               expert: int = 1, pipe: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the framework mesh. Works for 1 device (all axes size 1 except
     one) through multi-host pods; on real TPU slices
-    ``mesh_utils.create_device_mesh`` picks an ICI-contiguous layout."""
+    ``mesh_utils.create_device_mesh`` picks an ICI-contiguous layout.
+
+    **Multi-slice pods** (devices spanning several ICI slices joined by
+    DCN) are detected from ``slice_index`` and laid out with
+    ``create_hybrid_device_mesh``: the ``data`` axis splits across slices
+    — its only collective is the once-per-step gradient psum, the most
+    DCN-tolerant traffic — while every other axis (fsdp/tensor/sequence/
+    expert/pipe collectives run per layer or per hop) stays inside a
+    slice, riding ICI. This is the reference's multi-node NCCL scale-out
+    story (SURVEY.md §2.3) restated in mesh form."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     shape = resolve_axis_sizes(dp=dp, fsdp=fsdp, sequence=sequence,
                                tensor=tensor, expert=expert, pipe=pipe,
                                n_devices=n)
+    from jax.experimental import mesh_utils
+
+    n_slices = _slice_count(devices)
+    if n_slices > 1:
+        if shape[0] % n_slices != 0:
+            raise ValueError(
+                f"{n_slices} TPU slices joined by DCN: the data axis must "
+                f"split across them (dp={shape[0]} not divisible by "
+                f"{n_slices}). Non-data axes cannot span DCN — their "
+                f"per-layer collectives would leave ICI.")
+        dcn_shape = (n_slices,) + (1,) * (len(AXES) - 1)
+        ici_shape = (shape[0] // n_slices,) + tuple(shape[1:])
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=list(devices))
+        return Mesh(device_array, AXES)
     try:
-        from jax.experimental import mesh_utils
         device_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
     except Exception:
         device_array = np.asarray(list(devices)).reshape(shape)

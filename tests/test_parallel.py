@@ -71,6 +71,48 @@ def test_make_mesh_shapes(axes):
                                       "expert", "pipe"}
 
 
+class _SliceDev:
+    """Proxy giving a real device a fake slice_index (multi-slice pods
+    can't be simulated on CPU; the hybrid-mesh wiring can)."""
+
+    def __init__(self, d, s):
+        self._d = d
+        self.slice_index = s
+
+    def __getattr__(self, name):
+        return getattr(self._d, name)
+
+
+def test_multislice_mesh_uses_hybrid(monkeypatch):
+    """Devices spanning >1 slice route through create_hybrid_device_mesh
+    with data split across DCN and all other axes inside a slice."""
+    import numpy as np
+    from jax.experimental import mesh_utils
+
+    from distributed_pipeline_tpu.parallel import mesh as mesh_mod
+
+    devs = jax.devices()
+    proxies = [_SliceDev(d, i // 4) for i, d in enumerate(devs)]  # 2 slices
+    calls = {}
+
+    def fake_hybrid(ici_shape, dcn_shape, devices=None):
+        calls["ici"] = tuple(ici_shape)
+        calls["dcn"] = tuple(dcn_shape)
+        full = tuple(a * b for a, b in zip(dcn_shape, ici_shape))
+        return np.array([p._d for p in devices]).reshape(full)
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_hybrid)
+    m = mesh_mod.make_mesh(dp=4, tensor=2, devices=proxies)
+    assert calls["dcn"] == (2, 1, 1, 1, 1, 1)       # slices -> data axis
+    assert calls["ici"] == (2, 1, 1, 2, 1, 1)       # per-slice dp x tensor
+    assert m.shape["data"] == 4 and m.shape["tensor"] == 2
+
+    # dp not divisible by the slice count must fail loudly, not span DCN
+    # with a per-layer-collective axis
+    with pytest.raises(ValueError, match="data axis"):
+        mesh_mod.make_mesh(dp=1, fsdp=4, tensor=2, devices=proxies)
+
+
 def test_mesh_psum_rides_sharding():
     # The DDP-replacement property: an all-reduce emitted by XLA from a
     # NamedSharding, no explicit collective call.
