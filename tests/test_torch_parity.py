@@ -9,10 +9,14 @@ hyperparameters must produce the same loss, the same gradients, and the
 same parameters after full AdamW train steps, between this framework's
 jitted TrainLoop and an independent torch implementation.
 
-The torch side is a from-scratch functional mirror of models/gpt2.py
-(pre-LN blocks, fused-QKV einsum attention, tanh-GELU MLP, tied LM head,
-LayerNorm eps 1e-6) driven by torch.autograd + torch.optim.AdamW with the
-reference's linear LR anneal — no code shared with the JAX path.
+The torch side is a from-scratch functional mirror of BOTH workload
+families — models/gpt2.py (pre-LN blocks, fused-QKV einsum attention,
+tanh-GELU MLP, tied LM head, LayerNorm eps 1e-6) and models/diffuseq.py
+(partial noising q_sample, sinusoidal time MLP, x0-MSE + prior-tT +
+rounding-NLL objective) — driven by torch.autograd + torch.optim.AdamW
+with the reference's linear LR anneal; no code shared with the JAX path.
+The diffusion draws (timesteps, noise) are replicated from the trainer's
+step-derived keys so both sides consume identical randomness.
 """
 
 import numpy as np
@@ -37,12 +41,8 @@ def _unboxed(params):
     return meta.unbox(params)
 
 
-def _torch_weights(params):
-    """params['params'] (unboxed) -> flat dict of requires-grad torch
-    tensors, keyed like the flax tree."""
-    p = _unboxed(params)["params"]
-    out = {"word_emb": p["word_emb"]["embedding"],
-           "pos_emb": p["pos_emb"]}
+def _add_backbone_weights(p, out):
+    """Extract the shared TransformerBackbone weights (both families)."""
     for i in range(LAYERS):
         blk = p["backbone"][f"block_{i}"]
         out[f"b{i}.qkv"] = blk["attn"]["qkv"]
@@ -55,18 +55,27 @@ def _torch_weights(params):
         out[f"b{i}.wo"] = blk["mlp"]["wo"]
     out["ln_f.s"] = p["backbone"]["ln_f"]["scale"]
     out["ln_f.b"] = p["backbone"]["ln_f"]["bias"]
+
+
+def _to_torch(out):
     return {k: torch.tensor(np.asarray(v), requires_grad=True)
             for k, v in out.items()}
 
 
-def _torch_loss(w, ids_np):
-    """Forward + masked next-token NLL, mirroring models/gpt2.py exactly
-    (synthetic-lm batches: pad_mask and input_mask are all ones)."""
+def _torch_weights(params):
+    """params['params'] (unboxed) -> flat dict of requires-grad torch
+    tensors, keyed like the flax tree."""
+    p = _unboxed(params)["params"]
+    out = {"word_emb": p["word_emb"]["embedding"],
+           "pos_emb": p["pos_emb"]}
+    _add_backbone_weights(p, out)
+    return _to_torch(out)
+
+
+def _torch_blocks(w, h, bias):
+    """Pre-LN transformer stack + final LN, mirroring models/backbone.py
+    (additive attention ``bias`` [*, L, L]: causal triangle and/or pad)."""
     F = torch.nn.functional
-    ids = torch.tensor(ids_np, dtype=torch.long)
-    h = w["word_emb"][ids] + w["pos_emb"][None, :L]
-    tri = torch.tril(torch.ones(L, L, dtype=torch.bool))
-    bias = torch.where(tri, 0.0, -1e9)  # ops/attention.py NEG_INF
     for i in range(LAYERS):
         hn = F.layer_norm(h, (D,), w[f"b{i}.ln1.s"], w[f"b{i}.ln1.b"],
                           eps=1e-6)
@@ -81,7 +90,18 @@ def _torch_loss(w, ids_np):
         m = F.gelu(torch.einsum("bld,dm->blm", hn, w[f"b{i}.wi"]),
                    approximate="tanh")
         h = h + torch.einsum("blm,md->bld", m, w[f"b{i}.wo"])
-    h = F.layer_norm(h, (D,), w["ln_f.s"], w["ln_f.b"], eps=1e-6)
+    return F.layer_norm(h, (D,), w["ln_f.s"], w["ln_f.b"], eps=1e-6)
+
+
+def _torch_loss(w, ids_np):
+    """Forward + masked next-token NLL, mirroring models/gpt2.py exactly
+    (synthetic-lm batches: pad_mask and input_mask are all ones)."""
+    F = torch.nn.functional
+    ids = torch.tensor(ids_np, dtype=torch.long)
+    h = w["word_emb"][ids] + w["pos_emb"][None, :L]
+    tri = torch.tril(torch.ones(L, L, dtype=torch.bool))
+    bias = torch.where(tri, 0.0, -1e9)  # ops/attention.py NEG_INF
+    h = _torch_blocks(w, h, bias)
     logits = torch.einsum("bld,vd->blv", h, w["word_emb"])
     nll = F.cross_entropy(logits[:, :-1].reshape(-1, V),
                           ids[:, 1:].reshape(-1), reduction="none")
@@ -168,3 +188,165 @@ def test_three_adamw_steps_match_torch(tmp_path):
         np.testing.assert_allclose(
             np.asarray(jv), w[key].detach().numpy(),
             rtol=2e-4, atol=2e-6, err_msg=key)
+
+
+# ------------------------------------------------- DiffuSeq (diffusion) path
+
+E, T_STEPS = 128, 50  # emb_dim default, small schedule
+
+
+def _diffuseq_workload():
+    return create_model_from_config(
+        model_family="diffuseq", vocab_size=V, seq_len=L, hidden_size=D,
+        num_layers=LAYERS, num_heads=H, diffusion_steps=T_STEPS,
+        dtype="float32", attention_impl="xla")
+
+
+def _diffuseq_torch_weights(params):
+    p = _unboxed(params)["params"]
+    out = {"word_emb": p["word_emb"]["embedding"],
+           "pos_emb": p["pos_emb"],
+           "in_proj.k": p["in_proj"]["kernel"],
+           "in_proj.b": p["in_proj"]["bias"],
+           "tm0.k": p["time_mlp"]["layers_0"]["kernel"],
+           "tm0.b": p["time_mlp"]["layers_0"]["bias"],
+           "tm2.k": p["time_mlp"]["layers_2"]["kernel"],
+           "tm2.b": p["time_mlp"]["layers_2"]["bias"],
+           "out_proj.k": p["out_proj"]["kernel"],
+           "out_proj.b": p["out_proj"]["bias"]}
+    _add_backbone_weights(p, out)
+    return _to_torch(out)
+
+
+def _t_and_noise(rng, sched):
+    """Replicate diffuseq_losses' internal draws (models/diffuseq.py:149-152)
+    so the torch mirror consumes the SAME timesteps and noise — from the
+    SAME schedule the JAX workload under test holds."""
+    rng_t, rng_noise = jax.random.split(rng)
+    t = sched.sample_t(rng_t, B)
+    noise = jax.random.normal(rng_noise, (B, L, E), jnp.float32)
+    return np.asarray(t), np.asarray(noise)
+
+
+def _masked_mean_t(x, mask):
+    m = mask.to(x.dtype)
+    return (x * m).sum() / torch.clamp(m.sum(), min=1.0)
+
+
+def _torch_diffuseq_loss(w, batch, t_np, noise_np, sched):
+    """x0-MSE + prior tT + rounding NLL with partial noising, mirroring
+    models/diffuseq.py + models/diffusion.py given the pre-drawn (t, noise).
+    """
+    F = torch.nn.functional
+    ids = torch.tensor(batch["input_ids"], dtype=torch.long)
+    tgt = torch.tensor(batch["input_mask"], dtype=torch.float32)
+    pad = torch.tensor(batch["pad_mask"], dtype=torch.float32)
+    t = torch.tensor(t_np, dtype=torch.long)
+    noise = torch.tensor(noise_np)
+
+    x_start = w["word_emb"][ids]                                   # [B,L,E]
+    a = torch.tensor(sched.sqrt_alphas_cumprod)[t].reshape(B, 1, 1)
+    s = torch.tensor(sched.sqrt_one_minus_alphas_cumprod)[t].reshape(B, 1, 1)
+    x_t = torch.where(tgt[..., None] > 0, a * x_start + s * noise, x_start)
+
+    h = torch.einsum("ble,ed->bld", x_t, w["in_proj.k"]) + w["in_proj.b"]
+    half = D // 2
+    freqs = torch.exp(-np.log(10_000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    args = t.to(torch.float32)[:, None] * freqs[None]
+    temb = torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+    temb = F.silu(temb @ w["tm0.k"] + w["tm0.b"]) @ w["tm2.k"] + w["tm2.b"]
+    h = h + temb[:, None, :] + w["pos_emb"][None, :L]
+    bias = (1.0 - pad)[:, None, None, :] * -1e9  # pad-only, bidirectional
+    h = _torch_blocks(w, h, bias)
+    x0_hat = torch.einsum("bld,de->ble", h, w["out_proj.k"]) + w["out_proj.b"]
+
+    mse = _masked_mean_t(((x0_hat - x_start) ** 2).mean(-1), tgt)
+    aT = float(sched.sqrt_alphas_cumprod[-1])
+    tT = _masked_mean_t(((aT * x_start) ** 2).mean(-1), tgt)
+    logits = torch.einsum("ble,ve->blv", x_start, w["word_emb"])
+    nll = F.cross_entropy(logits.reshape(-1, V), ids.reshape(-1),
+                          reduction="none").reshape(B, L)
+    decoder_nll = _masked_mean_t(nll, tgt)
+    return mse + tT + decoder_nll
+
+
+def _seq2seq_batch(seed=0):
+    from distributed_pipeline_tpu.data import load_data_from_args
+    return next(load_data_from_args(
+        "train", batch_size=B, dataset="synthetic-seq2seq", seq_len=L,
+        vocab_size=V, seed=seed, skip_batches=seed))
+
+
+def test_diffuseq_loss_and_grads_match_torch():
+    wl = _diffuseq_workload()
+    params = wl.init_params(jax.random.PRNGKey(3))
+    batch = _seq2seq_batch()
+    key = jax.random.PRNGKey(9)
+    sched = wl.schedule
+    t_np, noise_np = _t_and_noise(key, sched)
+
+    def jax_loss(p):
+        return wl.compute_losses(
+            p, {k: jnp.asarray(v) for k, v in batch.items()}, key)["loss"]
+
+    j_loss, j_grads = jax.value_and_grad(jax_loss)(params)
+
+    w = _diffuseq_torch_weights(params)
+    t_loss = _torch_diffuseq_loss(w, batch, t_np, noise_np, sched)
+    t_loss.backward()
+
+    np.testing.assert_allclose(float(j_loss), float(t_loss.detach()),
+                               rtol=1e-5)
+    g = _unboxed(j_grads)["params"]
+    pairs = [("word_emb", g["word_emb"]["embedding"]),
+             ("in_proj.k", g["in_proj"]["kernel"]),
+             ("tm0.k", g["time_mlp"]["layers_0"]["kernel"]),
+             ("out_proj.b", g["out_proj"]["bias"]),
+             ("b1.qkv", g["backbone"]["block_1"]["attn"]["qkv"]),
+             ("ln_f.s", g["backbone"]["ln_f"]["scale"])]
+    for key_, jg in pairs:
+        np.testing.assert_allclose(np.asarray(jg), w[key_].grad.numpy(),
+                                   rtol=5e-4, atol=1e-6, err_msg=key_)
+
+
+def test_diffuseq_adamw_steps_match_torch(tmp_path):
+    """Full jitted TrainLoop steps on the diffusion workload vs torch:
+    the per-step rng is fold_in(fold_in(seed_key, step), microbatch_index),
+    so the mirror consumes the same timesteps/noise each step."""
+    wl = _diffuseq_workload()
+    batches = [_seq2seq_batch(s) for s in range(3)]
+
+    seed = 4
+    loop = TrainLoop(
+        model=wl, data=iter(batches), batch_size=B, microbatch=B, lr=LR,
+        ema_rate="0.9", learning_steps=TOTAL, log_interval=10 ** 9,
+        save_interval=10 ** 9, mesh=make_mesh(dp=8), seed=seed,
+        weight_decay=WD, checkpoint_dir=str(tmp_path))
+    w = _diffuseq_torch_weights(loop.state.params)
+    opt = torch.optim.AdamW(list(w.values()), lr=LR, betas=(0.9, 0.999),
+                            eps=1e-8, weight_decay=WD)
+
+    base = jax.random.PRNGKey(seed)
+    for step, batch in enumerate(batches):
+        loop.run_step(batch)
+        key = jax.random.fold_in(jax.random.fold_in(base, step), 0)
+        t_np, noise_np = _t_and_noise(key, wl.schedule)
+        for group in opt.param_groups:
+            group["lr"] = LR * max(0.0, 1.0 - step / TOTAL)
+        opt.zero_grad()
+        _torch_diffuseq_loss(w, batch, t_np, noise_np,
+                             wl.schedule).backward()
+        opt.step()
+
+    jp = _unboxed(loop.state.params)["params"]
+    checks = [("word_emb", jp["word_emb"]["embedding"]),
+              ("in_proj.k", jp["in_proj"]["kernel"]),
+              ("tm2.b", jp["time_mlp"]["layers_2"]["bias"]),
+              ("b0.qkv", jp["backbone"]["block_0"]["attn"]["qkv"]),
+              ("b1.wo", jp["backbone"]["block_1"]["mlp"]["wo"]),
+              ("out_proj.k", jp["out_proj"]["kernel"])]
+    for key_, jv in checks:
+        np.testing.assert_allclose(
+            np.asarray(jv), w[key_].detach().numpy(),
+            rtol=2e-4, atol=2e-6, err_msg=key_)
