@@ -184,17 +184,26 @@ class WordVocab:
 
 class JsonlSeq2SeqDataset:
     """DiffuSeq-format jsonl corpus: one ``{"src": ..., "trg": ...}`` object
-    per line in ``{split}.jsonl`` under ``data_dir``. Raw lines are held in
-    memory (fine for corpora up to a few GB); parsing/tokenization happens
-    lazily per item."""
+    per line in ``{split}.jsonl`` under ``data_dir``. Lines are indexed by
+    the native mmap index (``native/jsonl_index.cpp`` — O(lines) offsets,
+    zero line copies, file pages shared across loader processes) with a
+    hold-all-lines Python fallback; parsing/tokenization happens lazily per
+    item. Blank (whitespace-only, Python ``str.strip()`` semantics — the
+    native index mirrors it) lines are skipped on both paths."""
 
     def __init__(self, data_dir: str, split: str, seq_len: int = 128,
                  vocab_size: int = 8192, vocab_file: Optional[str] = None):
         path = os.path.join(data_dir, f"{split}.jsonl")
         if not os.path.exists(path):
             raise FileNotFoundError(path)
-        with open(path) as f:
-            self.lines = [ln for ln in f if ln.strip()]
+        self._index = None
+        self.lines: Optional[List[str]] = None
+        try:
+            from ..native import NativeJsonlIndex
+            self._index = NativeJsonlIndex(path)
+        except Exception:
+            with open(path) as f:
+                self.lines = [ln for ln in f if ln.strip()]
         if vocab_file is None:
             # prefer a trained subword artifact over word-level vocab
             bpe = os.path.join(data_dir, "bpe.json")
@@ -205,10 +214,16 @@ class JsonlSeq2SeqDataset:
         self.vocab_size = vocab_size
 
     def __len__(self) -> int:
+        if self._index is not None:
+            return len(self._index)
         return len(self.lines)
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
-        obj = json.loads(self.lines[idx])
+        if self._index is not None:
+            raw = self._index.line(idx)
+        else:
+            raw = self.lines[idx]
+        obj = json.loads(raw)
         src = self.vocab.encode(str(obj.get("src", "")))
         tgt = self.vocab.encode(str(obj.get("trg", obj.get("tgt", ""))))
         L = self.seq_len

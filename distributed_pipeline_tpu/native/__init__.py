@@ -1,14 +1,20 @@
 """Native (C++) runtime components, consumed via ``ctypes``.
 
 The reference keeps all native capability in external libraries (SURVEY.md
-§2.1); here the host-side data path gets its own native piece: a C++ BPE
-encoder (``bpe_encoder.cpp``) behind the exact contract of
-``data/tokenizer.py:BPEVocab``. The library is built on first use with the
-toolchain baked into the image (``g++``; no pybind11, so the binding is a
-plain C ABI + ctypes) and cached next to the source. Everything degrades
-gracefully: no compiler, a failed build, or ``DPT_NATIVE=0`` simply leaves
-the pure-Python encoder in charge — the same degrade-to-portable contract
-the distributed substrate follows (parallel/dist.py).
+§2.1); here the host-side data path gets its own native pieces:
+
+* ``bpe_encoder.cpp`` — the BPE merge loop behind the exact contract of
+  ``data/tokenizer.py:BPEVocab`` (~15x the Python throughput);
+* ``jsonl_index.cpp`` — mmap'd random access over jsonl corpora (offset
+  table instead of holding every line in Python memory; pages shared
+  across loader processes by the page cache).
+
+The library is built on first use with the toolchain baked into the image
+(``g++``/``clang++``; no pybind11, so the binding is a plain C ABI +
+ctypes) and cached next to the sources. Everything degrades gracefully:
+no compiler, a failed build, or ``DPT_NATIVE=0`` simply leaves the
+pure-Python paths in charge — the same degrade-to-portable contract the
+distributed substrate follows (parallel/dist.py).
 """
 
 from __future__ import annotations
@@ -21,11 +27,14 @@ import tempfile
 import threading
 from typing import Dict, List, Optional
 
-__all__ = ["load_library", "NativeBPE", "native_enabled"]
+__all__ = ["load_library", "NativeBPE", "NativeJsonlIndex",
+           "native_enabled"]
 
-_SRC = os.path.join(os.path.dirname(__file__), "bpe_encoder.cpp")
-_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
-_SO = os.path.join(_BUILD_DIR, "libdpt_bpe.so")
+_DIR = os.path.dirname(__file__)
+_SRCS = [os.path.join(_DIR, "bpe_encoder.cpp"),
+         os.path.join(_DIR, "jsonl_index.cpp")]
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD_DIR, "libdpt_native.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -60,11 +69,13 @@ def _build() -> bool:
     race benignly. Compiler: ``$CXX`` if set (same knob as the Makefile),
     else the first of g++/clang++ on PATH."""
     try:
+        have_srcs = all(os.path.exists(s) for s in _SRCS)
         if os.path.exists(_SO) and (
-                not os.path.exists(_SRC)  # prebuilt .so shipped without src
-                or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+                not have_srcs  # prebuilt .so shipped without sources
+                or os.path.getmtime(_SO) >= max(os.path.getmtime(s)
+                                                for s in _SRCS)):
             return True
-        if not os.path.exists(_SRC):
+        if not have_srcs:
             return False
         os.makedirs(_BUILD_DIR, exist_ok=True)
         env_cxx = os.environ.get("CXX")
@@ -75,7 +86,7 @@ def _build() -> bool:
             try:
                 proc = subprocess.run(
                     [cxx, "-O2", "-std=c++17", "-Wall", "-Wextra",
-                     "-shared", "-fPIC", "-o", tmp, _SRC],
+                     "-shared", "-fPIC", "-o", tmp] + _SRCS,
                     capture_output=True, text=True, timeout=120)
                 if proc.returncode == 0:
                     os.replace(tmp, _SO)
@@ -122,6 +133,16 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.dpt_bpe_oov_get.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.dpt_jsonl_open.restype = ctypes.c_void_p
+        lib.dpt_jsonl_open.argtypes = [ctypes.c_char_p]
+        lib.dpt_jsonl_count.restype = ctypes.c_int64
+        lib.dpt_jsonl_count.argtypes = [ctypes.c_void_p]
+        lib.dpt_jsonl_get.restype = ctypes.c_int64
+        lib.dpt_jsonl_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.dpt_jsonl_close.restype = None
+        lib.dpt_jsonl_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -204,3 +225,53 @@ class NativeBPE:
             # bounded word cache overflows) — so resolve under the lock.
             return [i if i >= 0 else self._resolve_oov(-i - 1)
                     for i in self._buf[:n]]
+
+
+class NativeJsonlIndex:
+    """mmap'd random access over a jsonl corpus (jsonl_index.cpp).
+
+    Replaces holding every line in a Python list: the offset table is the
+    only per-process memory (16 bytes/line), the file's pages stream in on
+    demand and are shared across loader processes by the page cache.
+    ``line(i)`` returns the decoded non-blank line i — blank means
+    ASCII-whitespace-only, the contract shared with the Python fallback in
+    ``data/dataset.py``."""
+
+    def __init__(self, path: str):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        handle = lib.dpt_jsonl_open(os.fspath(path).encode())
+        if not handle:
+            raise RuntimeError(f"native jsonl index failed to open {path!r}")
+        self._lib = lib
+        self._handle = handle
+        self._len = int(lib.dpt_jsonl_count(handle))
+        # line() runs once per __getitem__: keep one growable buffer
+        # instead of allocating per call (NativeBPE does the same). Guarded
+        # by a lock — loader worker threads share the dataset.
+        self._buf_cap = 4096
+        self._buf = (ctypes.c_uint8 * self._buf_cap)()
+        self._buf_lock = threading.Lock()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.dpt_jsonl_close(handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        return self._len
+
+    def line(self, i: int) -> str:
+        with self._buf_lock:
+            n = self._lib.dpt_jsonl_get(self._handle, i, self._buf,
+                                        self._buf_cap)
+            if n < 0:
+                raise IndexError(i)
+            if n > self._buf_cap:
+                self._buf_cap = int(n)
+                self._buf = (ctypes.c_uint8 * self._buf_cap)()
+                n = self._lib.dpt_jsonl_get(self._handle, i, self._buf,
+                                            self._buf_cap)
+            return bytes(self._buf[:n]).decode()

@@ -143,3 +143,69 @@ def test_jsonl_dataset_uses_native(tmp_path):
     assert ds.vocab._bpe is not None and ds.vocab._bpe._native is not None
     item = ds[0]
     assert item["input_ids"].shape == (32,)
+
+
+# ------------------------------------------------ mmap jsonl index
+
+@needs_native
+def test_jsonl_index_matches_python_lines(tmp_path):
+    from distributed_pipeline_tpu.native import NativeJsonlIndex
+
+    content = ('{"src": "a", "trg": "b"}\n'
+               '\n'                      # blank: skipped
+               '   \t \n'               # whitespace-only: skipped
+               '\u00a0\u2003\n'          # UNICODE-whitespace-only: skipped
+               '{"src": "c", "trg": "d"}\r\n'   # CRLF
+               '{"src": "cr", "trg": "only"}\r'  # lone-CR terminator
+               '{"src": "é", "trg": "日本"}\n'   # multi-byte
+               '{"src": "last", "trg": "noeol"}')  # no trailing newline
+    path = tmp_path / "train.jsonl"
+    path.write_bytes(content.encode())
+    idx = NativeJsonlIndex(str(path))
+    # ground truth = exactly what the Python fallback sees (text-mode
+    # universal newlines + ln.strip() filter)
+    with open(path) as f:
+        py = [ln.rstrip("\n") for ln in f if ln.strip()]
+    assert len(idx) == len(py) == 5
+    for i, expect in enumerate(py):
+        assert idx.line(i) == expect
+    with pytest.raises(IndexError):
+        idx.line(len(py))
+
+
+@needs_native
+def test_jsonl_index_empty_file(tmp_path):
+    from distributed_pipeline_tpu.native import NativeJsonlIndex
+
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    idx = NativeJsonlIndex(str(path))
+    assert len(idx) == 0
+
+
+@needs_native
+def test_jsonl_dataset_uses_index_and_matches_fallback(tmp_path, monkeypatch):
+    import json
+
+    import numpy as np
+
+    from distributed_pipeline_tpu.data.dataset import JsonlSeq2SeqDataset
+
+    rows = [{"src": f"word{i} común", "trg": f"tok{i} 日本"}
+            for i in range(7)]
+    with open(tmp_path / "train.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r, ensure_ascii=False) + "\n\n")  # + blanks
+    ds = JsonlSeq2SeqDataset(str(tmp_path), "train", seq_len=32,
+                             vocab_size=128)
+    assert ds._index is not None and len(ds) == 7
+    items_native = [ds[i] for i in range(7)]
+
+    # force the fallback path and compare every produced array
+    monkeypatch.setenv("DPT_NATIVE", "0")
+    ds2 = JsonlSeq2SeqDataset(str(tmp_path), "train", seq_len=32,
+                              vocab_size=128)
+    assert ds2._index is None and len(ds2) == 7
+    for a, b in zip(items_native, (ds2[i] for i in range(7))):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
