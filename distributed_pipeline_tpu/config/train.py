@@ -39,6 +39,11 @@ class GeneralSettings(S):
     checkpoint_path: str = _("", "run/checkpoint directory (auto-generated if empty)")
     gradient_clipping: float = _(-1.0, "global-norm gradient clip; <=0 disables")
     weight_decay: float = _(0.0, "AdamW decoupled weight decay")
+    warmup_steps: int = _(0, "linear LR warmup steps before the anneal "
+                             "(0 = reference behavior: no warmup)")
+    keep_checkpoints: int = _(0, "retain only the newest N checkpoint steps "
+                                 "(model+EMA+opt pruned together); 0 = keep "
+                                 "all (reference behavior)")
     debug_nans: bool = _(False, "enable jax_debug_nans: fail loudly at the op "
                                 "that first produces a NaN (debug runs only; "
                                 "disables async dispatch)")

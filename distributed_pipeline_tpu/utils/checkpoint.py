@@ -32,7 +32,7 @@ __all__ = [
     "save_checkpoint", "restore_checkpoint", "restore_resume_state",
     "resume_target",
     "parse_step_from_name", "find_resume_checkpoint", "find_ema_checkpoint",
-    "find_opt_checkpoint", "latest_step",
+    "find_opt_checkpoint", "latest_step", "prune_checkpoints",
 ]
 
 _STEP_RE = re.compile(r"(\d{6,})$")
@@ -123,6 +123,29 @@ def save_checkpoint(directory: str, step: int, params: Any,
         ckptr.save(d / f"opt_{step:06d}", opt_state, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
+
+
+def prune_checkpoints(directory: str, keep: int) -> List[int]:
+    """Delete all but the newest ``keep`` checkpoint steps (model + every
+    companion ``ema_*``/``opt_`` of the pruned step). The reference keeps
+    everything; at three EMA rates + optimizer state a 320k-step run
+    accumulates ~5x params-size per save, so long runs need a retention
+    policy. Process 0 only (single-writer, like the save protocol);
+    returns the pruned step numbers. ``keep <= 0`` disables pruning."""
+    if keep <= 0 or jax.process_index() != 0:
+        return []
+    steps = [s for s, _ in _scan(directory, "model_")]
+    doomed = set(steps[:-keep] if len(steps) > keep else [])
+    if not doomed:
+        return []
+    # ONE directory listing, bucketed by parsed step — per-step re-listing
+    # would be a remote LIST per pruned step on gs:// run dirs.
+    for child in epath.Path(directory).iterdir():
+        name = child.name
+        if (name.startswith(("model_", "ema_", "opt_"))
+                and parse_step_from_name(name) in doomed):
+            child.rmtree()
+    return sorted(doomed)
 
 
 def restore_checkpoint(path: str, abstract_target: Any) -> Any:

@@ -109,6 +109,8 @@ class TrainLoop:
         checkpoint_dir: str = "",
         seed: int = 102,
         profile_dir: str = "",
+        warmup_steps: int = 0,
+        keep_checkpoints: int = 0,
     ) -> None:
         self.workload = model
         self.data = data
@@ -130,6 +132,8 @@ class TrainLoop:
         self.gradient_clipping = gradient_clipping
         self.weight_decay = weight_decay
         self.learning_steps = learning_steps
+        self.warmup_steps = warmup_steps
+        self.keep_checkpoints = keep_checkpoints
         self.checkpoint_dir = checkpoint_dir or logger.get_dir() or ""
         # SURVEY.md §5.1 rebuild note: a first-class jax.profiler trace hook.
         # A short window a few steps in (past compilation) is captured into
@@ -165,13 +169,27 @@ class TrainLoop:
     def _make_optimizer(self) -> optax.GradientTransformation:
         """AdamW with the reference's linear anneal ``lr*(1-step/total)``
         (trainer.py:257-263) and decoupled weight decay (trainer.py:99)."""
-        if self.learning_steps > 0:
-            sched = lambda step: self.lr * jnp.maximum(
-                0.0, 1.0 - step / self.learning_steps)
-        else:
-            sched = self.lr
+        # Constant-LR runs keep the plain float (not a schedule callable):
+        # a callable changes the opt_state pytree structure
+        # (ScaleByScheduleState vs empty ScaleState), which would break
+        # optimizer-state restore of checkpoints saved before a schedule
+        # was in play.
+        sched = (self._lr_at if self.learning_steps > 0
+                 or self.warmup_steps > 0 else self.lr)
         return optax.adamw(sched, b1=0.9, b2=0.999, eps=1e-8,
                            weight_decay=self.weight_decay)
+
+    def _lr_at(self, step):
+        """Reference linear anneal ``lr*(1-step/total)`` (trainer.py:257-263),
+        optionally preceded by a linear warmup from 0 over ``warmup_steps``
+        (exceeds the reference; default 0 keeps its exact schedule). One
+        method is BOTH the optax schedule and the logged lr gauge."""
+        lr = jnp.asarray(self.lr, jnp.float32)
+        if self.learning_steps > 0:
+            lr = lr * jnp.maximum(0.0, 1.0 - step / self.learning_steps)
+        if self.warmup_steps > 0:
+            lr = lr * jnp.minimum(1.0, (step + 1) / self.warmup_steps)
+        return lr
 
     def _build_state(self, resume_checkpoint: str) -> None:
         wl = self.workload
@@ -238,11 +256,7 @@ class TrainLoop:
         rates = self.ema_rates
         pshard = self._pshard
         base_rng = self._base_rng
-        if self.learning_steps > 0:
-            lr, total = self.lr, self.learning_steps
-            lr_at = lambda step: lr * jnp.maximum(0.0, 1.0 - step / total)
-        else:
-            lr_at = lambda step: jnp.asarray(self.lr)
+        lr_at = self._lr_at
 
         def micro_scan(params: Any, batch: Dict[str, jnp.ndarray],
                        rng: jax.Array, with_grad: bool):
@@ -446,3 +460,8 @@ class TrainLoop:
             opt_state=self.state.opt_state)
         logger.info(f"saved checkpoint at step {self.step} "
                     f"-> {self.checkpoint_dir}")
+        pruned = ckpt_lib.prune_checkpoints(self.checkpoint_dir,
+                                            self.keep_checkpoints)
+        if pruned:
+            logger.info(f"pruned checkpoints at steps {pruned} "
+                        f"(keep_checkpoints={self.keep_checkpoints})")

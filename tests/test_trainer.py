@@ -328,3 +328,60 @@ def test_debug_nans_flag_fails_loudly(tmp_path):
             run_train.main(ns)
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_lr_warmup_schedule(tmp_path):
+    """--warmup_steps ramps LR linearly before the reference anneal;
+    warmup_steps=0 reproduces the reference schedule exactly."""
+    loop = make_loop(tmp_path, lr=1e-3, learning_steps=100)
+    assert np.isclose(float(loop._lr_at(0)), 1e-3)
+    assert np.isclose(float(loop._lr_at(50)), 5e-4)
+
+    loop_w = make_loop(tmp_path / "w", lr=1e-3, learning_steps=100,
+                       warmup_steps=10)
+    assert np.isclose(float(loop_w._lr_at(0)), 1e-3 * (1 / 10))
+    assert np.isclose(float(loop_w._lr_at(4)), 1e-3 * (5 / 10) * 0.96)
+    # past warmup: anneal only
+    assert np.isclose(float(loop_w._lr_at(50)), 5e-4)
+    # and the jitted step consumes it without recompilation issues
+    m = loop_w.run_step(next(loop_w.data))
+    assert np.isclose(float(m["lr"]), 1e-3 * (1 / 10) * 1.0, rtol=1e-3)
+
+
+def test_keep_checkpoints_prunes_old_steps(tmp_path):
+    """--keep_checkpoints N retains only the newest N steps, pruning
+    model+EMA+opt together; 0 keeps everything (reference behavior)."""
+    loop = make_loop(tmp_path, keep_checkpoints=2, save_interval=10 ** 9)
+    for _ in range(3):
+        loop.run_step(next(loop.data))
+        loop.save()
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert [n for n in names if n.startswith("model_")] == [
+        "model_000002", "model_000003"]
+    assert not any(n.endswith("000001") for n in names), names
+    # companions of surviving steps intact
+    assert any(n.startswith("ema_") and n.endswith("000003") for n in names)
+    assert "opt_000003" in names
+
+    # keep_checkpoints=0: nothing pruned
+    loop0 = make_loop(tmp_path / "all", keep_checkpoints=0,
+                      save_interval=10 ** 9)
+    for _ in range(3):
+        loop0.run_step(next(loop0.data))
+        loop0.save()
+    names0 = [p.name for p in (tmp_path / "all").iterdir()
+              if p.name.startswith("model_")]
+    assert len(names0) == 3
+
+
+def test_constant_lr_optstate_resumes(tmp_path):
+    """Constant-LR runs (learning_steps=0) must keep the plain-float optax
+    schedule so their opt_state pytree structure stays restorable."""
+    loop = make_loop(tmp_path, learning_steps=0, save_interval=10 ** 9)
+    loop.run_step(next(loop.data))
+    loop.save()
+    loop2 = make_loop(tmp_path, learning_steps=0)
+    assert loop2.step == 1
+    m = loop2.run_step(next(loop2.data))
+    assert np.isfinite(float(m["loss"]))
+    assert np.isclose(float(m["lr"]), loop2.lr)
