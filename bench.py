@@ -140,6 +140,15 @@ def main() -> None:
         measure("gpt2-medium-seq128", family="gpt2", size="medium",
                 seq_len=128, batch=(bsz(256), bsz(64), bsz(32)),
                 microbatch=bsz(32)),
+        # Long context (exceeds the BASELINE shapes): the Pallas flash
+        # kernel path — "auto" picks it on TPU from 1k context — at 4k,
+        # where the dense [L, L] logits would dominate HBM traffic
+        # (measured 1.67x the XLA path at this shape on v5e). The CPU
+        # smoke run shrinks the sequence: a 4k dense attention on one CPU
+        # core takes minutes and measures nothing.
+        measure("gpt2-base-seq4096-flash", family="gpt2", size="base",
+                seq_len=4096 if on_tpu else 256,
+                batch=(bsz(16), bsz(8), bsz(4)), microbatch=bsz(2)),
     ]
 
     head = configs[0]

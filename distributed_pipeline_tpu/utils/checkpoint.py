@@ -51,6 +51,19 @@ def parse_step_from_name(name: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
+_ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp"
+
+
+def _is_unfinalized(name: str) -> bool:
+    """Orbax writes into ``<name>.orbax-checkpoint-tmp-<timestamp>`` and
+    renames on finalize; a crash mid-save leaves the tmp behind. Its
+    trailing timestamp parses as a huge step, so treating it as a real
+    checkpoint would (a) make auto-resume pick an unrestorable directory
+    and (b) make retention-pruning rank it newest and delete genuine
+    checkpoints instead."""
+    return _ORBAX_TMP_MARKER in name
+
+
 def _scan(directory: str, prefix: str) -> List[Tuple[int, str]]:
     if not directory:
         return []
@@ -59,7 +72,7 @@ def _scan(directory: str, prefix: str) -> List[Tuple[int, str]]:
         return []
     out = []
     for child in d.iterdir():
-        if child.name.startswith(prefix):
+        if child.name.startswith(prefix) and not _is_unfinalized(child.name):
             step = parse_step_from_name(child.name)
             if step is not None:
                 out.append((step, os.fspath(child)))
@@ -134,14 +147,22 @@ def prune_checkpoints(directory: str, keep: int) -> List[int]:
     returns the pruned step numbers. ``keep <= 0`` disables pruning."""
     if keep <= 0 or jax.process_index() != 0:
         return []
-    steps = [s for s, _ in _scan(directory, "model_")]
+    d = epath.Path(directory)
+    if not d.is_dir():
+        return []
+    # ONE directory listing serves both the step ranking and the deletes —
+    # each listing is a remote LIST on gs:// run dirs. Unfinalized Orbax
+    # tmp dirs are excluded from BOTH: they must never rank as checkpoints
+    # nor be deleted (one may be a save in flight).
+    children = [(child, child.name) for child in d.iterdir()
+                if not _is_unfinalized(child.name)]
+    steps = sorted(parse_step_from_name(n) for _, n in children
+                   if n.startswith("model_")
+                   and parse_step_from_name(n) is not None)
     doomed = set(steps[:-keep] if len(steps) > keep else [])
     if not doomed:
         return []
-    # ONE directory listing, bucketed by parsed step — per-step re-listing
-    # would be a remote LIST per pruned step on gs:// run dirs.
-    for child in epath.Path(directory).iterdir():
-        name = child.name
+    for child, name in children:
         if (name.startswith(("model_", "ema_", "opt_"))
                 and parse_step_from_name(name) in doomed):
             child.rmtree()

@@ -385,3 +385,28 @@ def test_constant_lr_optstate_resumes(tmp_path):
     m = loop2.run_step(next(loop2.data))
     assert np.isfinite(float(m["loss"]))
     assert np.isclose(float(m["lr"]), loop2.lr)
+
+
+def test_unfinalized_orbax_tmp_ignored(tmp_path):
+    """A crash mid-save leaves 'model_NNNNNN.orbax-checkpoint-tmp-<ts>';
+    its trailing timestamp must NOT rank as a step — neither for resume
+    discovery nor for retention pruning (which would otherwise delete real
+    checkpoints and keep the corrupt tmp)."""
+    d = str(tmp_path)
+    tree = {"a": jnp.arange(4.0)}
+    ckpt.save_checkpoint(d, 1, tree)
+    ckpt.save_checkpoint(d, 2, tree)
+    (tmp_path / "model_000003.orbax-checkpoint-tmp-1712345678901234").mkdir()
+
+    assert ckpt.latest_step(d) == 2
+    assert ckpt.find_resume_checkpoint(d).endswith("model_000002")
+
+    pruned = ckpt.prune_checkpoints(d, keep=2)
+    assert pruned == []  # two real steps, both kept; tmp didn't count
+    ckpt.save_checkpoint(d, 4, tree)
+    pruned = ckpt.prune_checkpoints(d, keep=2)
+    assert pruned == [1]
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "model_000002" in names and "model_000004" in names
+    # the in-flight/corrupt tmp is left alone
+    assert "model_000003.orbax-checkpoint-tmp-1712345678901234" in names
