@@ -32,12 +32,46 @@ from .diffuseq import DiffuSeqModel
 
 __all__ = [
     "diffuseq_sample",
+    "gpt2_decode",
     "gpt2_greedy_decode",
     "gpt2_decode_and_score",
     "gpt2_decode_accuracy",
     "target_span_accuracy",
     "make_decode_callback",
 ]
+
+
+def _next_token_fn(temperature: float, top_k: int, top_p: float,
+                   rng: Optional[jax.Array]):
+    """Token picker for one decode step: ``(logits [B, V], position) ->
+    ids [B]``. ``temperature <= 0`` is exact greedy argmax; otherwise
+    categorical sampling after temperature scaling with optional top-k
+    truncation and nucleus (top-p) truncation — all static flags, so the
+    whole picker traces into the decode loop."""
+    if temperature <= 0.0:
+        return lambda logits, i: jnp.argmax(logits, axis=-1)
+    if rng is None:
+        raise ValueError("stochastic decoding (temperature > 0) needs rng")
+
+    def pick(logits: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
+        l = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            # clamp: top_k >= vocab means "no truncation", not a trace error
+            k = min(top_k, l.shape[-1])
+            kth = jax.lax.top_k(l, k)[0][..., -1:]  # [B, 1]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        if 0.0 < top_p < 1.0:
+            sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_l, axis=-1)
+            # smallest prefix with cumulative mass >= top_p; the token that
+            # crosses the threshold stays in
+            keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+            cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf),
+                             axis=-1, keepdims=True)
+            l = jnp.where(l < cutoff, -jnp.inf, l)
+        return jax.random.categorical(jax.random.fold_in(rng, i), l, axis=-1)
+
+    return pick
 
 
 def _sample_timesteps(T: int, sample_steps: int) -> np.ndarray:
@@ -108,10 +142,18 @@ def diffuseq_sample(workload, params, batch: Dict[str, jnp.ndarray],
     return jnp.where(tgt[..., 0], gen, ids)
 
 
-def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
-                       prompt_len: int, use_cache: bool = True) -> jnp.ndarray:
-    """Greedily continue ``ids[:, :prompt_len]`` out to the full seq_len;
-    int32 [B, L] out.
+def gpt2_decode(workload, params, ids: jnp.ndarray,
+                prompt_len: int, use_cache: bool = True,
+                temperature: float = 0.0, top_k: int = 0,
+                top_p: float = 0.0,
+                rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Continue ``ids[:, :prompt_len]`` out to the full seq_len; int32
+    [B, L] out. ``temperature=0`` (default) is greedy argmax; > 0 samples
+    from the temperature-scaled distribution, optionally truncated to the
+    ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus.
+    Sampling is deterministic given ``rng`` (per-position fold_in), and
+    identical between the cached and uncached paths (same logits, same
+    per-position key).
 
     ``use_cache=True`` (default) runs the KV-cache path: one full-length
     prefill populates every layer's K/V cache (stale tail entries are
@@ -120,6 +162,7 @@ def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
     instead of a full O(L^2) re-forward. ``use_cache=False`` recomputes the
     full forward per position — the reference implementation the cache path
     is tested against."""
+    pick = _next_token_fn(temperature, top_k, top_p, rng)
     # Inference never drops MoE tokens (capacity competition is a training
     # device; per-token top-k routing at decode time is exact and makes the
     # cached and uncached paths bit-identical — models/moe.py).
@@ -134,14 +177,17 @@ def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
     if not use_cache:
         def body(i, ids):
             logits = model.apply(params, ids, pad)        # [B, L, V]
-            nxt = jnp.argmax(logits[:, i - 1], axis=-1).astype(ids.dtype)
+            nxt = pick(logits[:, i - 1], i).astype(ids.dtype)
             return ids.at[:, i].set(nxt)
 
         return jax.lax.fori_loop(prompt_len, L, body, ids)
 
     dm = model.clone(decode=True)
     logits, vars_ = dm.apply(params, ids, pad, mutable=["cache"])
-    first = jnp.argmax(logits[:, prompt_len - 1], axis=-1).astype(ids.dtype)
+    # position argument = the index being WRITTEN (prompt_len here), so the
+    # cached and uncached paths fold the same key for the same position
+    first = pick(logits[:, prompt_len - 1],
+                 jnp.asarray(prompt_len)).astype(ids.dtype)
     ids = ids.at[:, prompt_len].set(first) if prompt_len < L else ids
 
     def body(i, carry):
@@ -150,12 +196,19 @@ def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
         logits, updated = dm.apply(
             {**params, "cache": cache}, tok, None, cache_index=i,
             mutable=["cache"])
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(ids.dtype)
+        nxt = pick(logits[:, 0], i + 1).astype(ids.dtype)
         return ids.at[:, i + 1].set(nxt), updated["cache"]
 
     ids, _ = jax.lax.fori_loop(prompt_len, L - 1, body,
                                (ids, vars_["cache"]))
     return ids
+
+
+def gpt2_greedy_decode(workload, params, ids: jnp.ndarray,
+                       prompt_len: int, use_cache: bool = True) -> jnp.ndarray:
+    """Greedy continuation (``gpt2_decode`` at temperature 0)."""
+    return gpt2_decode(workload, params, ids, prompt_len,
+                       use_cache=use_cache)
 
 
 def target_span_accuracy(pred_ids: jnp.ndarray,
@@ -168,12 +221,17 @@ def target_span_accuracy(pred_ids: jnp.ndarray,
 
 
 def gpt2_decode_and_score(workload, params, batch: Dict[str, jnp.ndarray],
-                          prompt_len: int = 0):
-    """Greedy-decode the suffix after ``prompt_len`` (default seq_len/2) and
+                          prompt_len: int = 0, temperature: float = 0.0,
+                          top_k: int = 0, top_p: float = 0.0,
+                          rng: Optional[jax.Array] = None):
+    """Decode the suffix after ``prompt_len`` (default seq_len/2; greedy by
+    default, stochastic with ``temperature``/``top_k``/``top_p``) and
     score it against the gold continuation — the one span-accounting used by
     both the eval callback and run.sample. Returns (pred_ids, accuracy)."""
     plen = prompt_len or workload.seq_len // 2
-    pred = gpt2_greedy_decode(workload, params, batch["input_ids"], plen)
+    pred = gpt2_decode(workload, params, batch["input_ids"], plen,
+                       temperature=temperature, top_k=top_k, top_p=top_p,
+                       rng=rng)
     gen_mask = jnp.broadcast_to(
         (jnp.arange(workload.seq_len) >= plen).astype(jnp.int32), pred.shape)
     acc = target_span_accuracy(

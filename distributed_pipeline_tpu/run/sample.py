@@ -41,12 +41,27 @@ def create_parser() -> argparse.ArgumentParser:
                    help="disable DiffuSeq's nearest-embedding clamping")
     p.add_argument("--prompt_len", type=int, default=0,
                    help="gpt2: prompt prefix length (0 = seq_len/2)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="gpt2: 0 = greedy; > 0 samples from the "
+                        "temperature-scaled distribution")
+    p.add_argument("--top_k", type=int, default=0,
+                   help="gpt2: restrict sampling to the k most likely "
+                        "tokens (0 = off)")
+    p.add_argument("--top_p", type=float, default=0.0,
+                   help="gpt2: nucleus sampling mass (0 = off)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="sampling seed (stochastic gpt2 decoding)")
     p.add_argument("--out", default="",
                    help="write decoded batches as JSONL to this path")
     return p
 
 
 def main(ns: argparse.Namespace) -> dict:
+    if (ns.top_k > 0 or ns.top_p > 0) and ns.temperature <= 0:
+        raise SystemExit(
+            "--top_k/--top_p shape the SAMPLING distribution; with the "
+            "default --temperature 0 decoding is greedy and they would be "
+            "silently ignored. Pass --temperature > 0.")
     import jax
     import jax.numpy as jnp
 
@@ -70,7 +85,7 @@ def main(ns: argparse.Namespace) -> dict:
         ns.split, **{**targs, "batch_size": ns.batch_size,
                      "deterministic": True})
 
-    rng = jax.random.PRNGKey(0)
+    rng = jax.random.PRNGKey(ns.seed)
     abstract = jax.eval_shape(wl.init_params, rng)
     from flax import linen as nn
     abstract = nn.meta.unbox(abstract)
@@ -101,8 +116,9 @@ def main(ns: argparse.Namespace) -> dict:
             return pred, target_span_accuracy(pred, b)
     else:
         def _decode(p, b, r):
-            del r
-            return gpt2_decode_and_score(wl, p, b, ns.prompt_len)
+            return gpt2_decode_and_score(
+                wl, p, b, ns.prompt_len, temperature=ns.temperature,
+                top_k=ns.top_k, top_p=ns.top_p, rng=r)
     decode = jax.jit(_decode)
 
     accs, losses, rows = [], [], []

@@ -160,3 +160,57 @@ def test_run_sample_cli_raw_and_ema(tmp_path):
         bad = run_sample.create_parser().parse_args(
             ["--checkpoint_path", str(tmp_path / "run"), "--ema", "0.123"])
         run_sample.main(bad)
+
+
+def test_gpt2_stochastic_decode():
+    """temperature/top_k/top_p sampling: deterministic given rng, identical
+    between cached and uncached paths, top_k=1 == greedy, and temperature
+    actually diversifies output."""
+    from distributed_pipeline_tpu.models.sampling import gpt2_decode
+
+    wl = tiny_workload("gpt2")
+    params = wl.init_params(jax.random.PRNGKey(1))
+    batch = valid_batch("gpt2", batch_size=4)
+    ids, plen = batch["input_ids"], SEQ // 2
+    rng = jax.random.PRNGKey(7)
+
+    a = gpt2_decode(wl, params, ids, plen, temperature=1.0, rng=rng)
+    b = gpt2_decode(wl, params, ids, plen, temperature=1.0, rng=rng)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(a)[:, :plen],
+                                  np.asarray(ids)[:, :plen])
+    assert int(a.min()) >= 0 and int(a.max()) < VOCAB
+
+    # cached and uncached sampling draw the same tokens (same logits, same
+    # per-position keys)
+    slow = gpt2_decode(wl, params, ids, plen, use_cache=False,
+                       temperature=1.0, rng=rng)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(slow))
+
+    # a different key gives a different continuation (untrained model:
+    # near-uniform logits, collision chance ~0)
+    c = gpt2_decode(wl, params, ids, plen, temperature=1.0,
+                    rng=jax.random.PRNGKey(8))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    # top_k=1 degenerates to greedy regardless of temperature
+    greedy = gpt2_decode(wl, params, ids, plen)
+    k1 = gpt2_decode(wl, params, ids, plen, temperature=5.0, top_k=1,
+                     rng=rng)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    # tiny nucleus keeps only the argmax token -> greedy
+    p_tiny = gpt2_decode(wl, params, ids, plen, temperature=1.0,
+                         top_p=1e-6, rng=rng)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+
+
+def test_gpt2_stochastic_needs_rng():
+    from distributed_pipeline_tpu.models.sampling import gpt2_decode
+
+    wl = tiny_workload("gpt2")
+    params = wl.init_params(jax.random.PRNGKey(1))
+    batch = valid_batch("gpt2", batch_size=2)
+    with pytest.raises(ValueError, match="rng"):
+        gpt2_decode(wl, params, batch["input_ids"], SEQ // 2,
+                    temperature=1.0)
