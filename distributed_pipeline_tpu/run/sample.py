@@ -37,6 +37,9 @@ def create_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_batches", type=int, default=2)
     p.add_argument("--sample_steps", type=int, default=64,
                    help="reverse-diffusion steps (diffuseq; <=0 = all)")
+    p.add_argument("--mbr", type=int, default=1,
+                   help="diffuseq: minimum-Bayes-risk decoding over this "
+                        "many candidates (1 = single sample)")
     p.add_argument("--no_clamp", action="store_true",
                    help="disable DiffuSeq's nearest-embedding clamping")
     p.add_argument("--prompt_len", type=int, default=0,
@@ -68,7 +71,7 @@ def main(ns: argparse.Namespace) -> dict:
     from ..data import load_data_from_args
     from ..models import create_model_from_config
     from ..models.sampling import (
-        diffuseq_sample,
+        diffuseq_sample_mbr,
         gpt2_decode_and_score,
         target_span_accuracy,
     )
@@ -111,8 +114,9 @@ def main(ns: argparse.Namespace) -> dict:
 
     if wl.family == "diffuseq":
         def _decode(p, b, r):
-            pred = diffuseq_sample(wl, p, b, r, ns.sample_steps,
-                                   clamp=not ns.no_clamp)
+            pred = diffuseq_sample_mbr(wl, p, b, r, ns.mbr,
+                                       ns.sample_steps,
+                                       clamp=not ns.no_clamp)
             return pred, target_span_accuracy(pred, b)
     else:
         def _decode(p, b, r):
