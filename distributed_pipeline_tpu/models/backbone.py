@@ -194,18 +194,14 @@ class TransformerBackbone(nn.Module):
                  pad_mask: Optional[jnp.ndarray] = None,
                  cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         if self.scan_layers:
-            if self.decode:
-                raise ValueError(
-                    "scan_layers does not support the KV-cache decode path "
-                    "yet; sampling falls back to full-recompute greedy "
-                    "decoding automatically (models/sampling.py)")
             from .pipeline import PipelinedBlocks
             x = PipelinedBlocks(
                 self.num_layers, self.num_heads, x.shape[-1],
                 dtype=self.dtype, causal=self.causal, remat=self.remat,
                 pp_chunks=self.pp_chunks,
                 attention_impl=self.attention_impl,
-                name="blocks")(x, pad_mask)
+                decode=self.decode,
+                name="blocks")(x, pad_mask, cache_index)
             return nn.LayerNorm(dtype=jnp.float32,
                                 name="ln_f")(x).astype(self.dtype)
         block_cls = Block

@@ -26,8 +26,10 @@ Design:
 * **Composition, v1 scope** — composes with ``data``/``expert`` batch
   sharding. ``fsdp``/``tensor``/``sequence`` > 1 alongside ``pipe`` > 1 is
   rejected (weight gathering inside stages and ring-in-stage come later);
-  MoE and KV-cache decode are likewise not yet available in stacked mode
-  (the factory rejects those combinations).
+  MoE is not yet available in stacked mode (the factory rejects it).
+  KV-cache decode works in stacked mode at ``pipe == 1`` (``decode=True``,
+  mirroring backbone.SelfAttention's contract); under ``pipe > 1`` the
+  sampler falls back to the full-recompute gpipe forward.
 
 The pure-function block forward here is numerically identical to
 backbone.Block (same pre-LN residual structure, f32 layernorm statistics,
@@ -60,12 +62,22 @@ def _layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
+def _block_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
+               dtype: jnp.dtype) -> jnp.ndarray:
+    h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dtype)
+    h = jnp.einsum("bld,dm->blm", h, lp["wi"].astype(dtype))
+    h = nn.gelu(h, approximate=True)
+    return x + jnp.einsum("blm,md->bld", h, lp["wo"].astype(dtype))
+
+
 def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
               pad_mask: Optional[jnp.ndarray], *, num_heads: int,
               dtype: jnp.dtype, causal: bool,
-              attention_impl: str = "xla") -> jnp.ndarray:
+              attention_impl: str = "xla", return_kv: bool = False):
     """One pre-LN transformer block as a pure function of its param dict
-    (the stacked-per-layer slice) — the math of backbone.Block."""
+    (the stacked-per-layer slice) — the math of backbone.Block.
+    ``return_kv=True`` also returns this layer's (k, v) [B, H, L, Dh]
+    (the KV-cache prefill path)."""
     B, L, D = x.shape
     H = num_heads
     h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
@@ -73,10 +85,29 @@ def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     o = dot_product_attention(qkv[0], qkv[1], qkv[2], pad_mask,
                               causal=causal, impl=attention_impl)
     x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
-    h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dtype)
-    h = jnp.einsum("bld,dm->blm", h, lp["wi"].astype(dtype))
-    h = nn.gelu(h, approximate=True)
-    return x + jnp.einsum("blm,md->bld", h, lp["wo"].astype(dtype))
+    out = _block_mlp(lp, x, dtype)
+    if return_kv:
+        return out, (qkv[1], qkv[2])
+    return out
+
+
+def block_decode_step(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
+                      ck: jnp.ndarray, cv: jnp.ndarray, idx: jnp.ndarray,
+                      live: jnp.ndarray, *, num_heads: int,
+                      dtype: jnp.dtype):
+    """Single-token step of one block against its KV cache: write position
+    ``idx`` of ``ck``/``cv`` [B, H, Lmax, Dh], attend the one query to the
+    live prefix (``live`` [B, Lmax] — causality IS this mask for one query
+    row), return (out [B, 1, D], ck, cv). Mirrors
+    backbone.SelfAttention._cached_attention for stacked weights."""
+    h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
+    qkv = jnp.einsum("bld,dthk->tbhlk", h, lp["qkv"].astype(dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]                  # [B, H, 1, Dh]
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, idx, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, idx, 0))
+    o = dot_product_attention(q, ck, cv, live, causal=False, impl="xla")
+    x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
+    return _block_mlp(lp, x, dtype), ck, cv
 
 
 class PipelinedBlocks(nn.Module):
@@ -91,6 +122,7 @@ class PipelinedBlocks(nn.Module):
     pp_chunks: int = 4
     attention_impl: str = "xla"
     remat: bool = False
+    decode: bool = False  # KV-cache generation (scan_layers, pipe == 1)
 
     def _impl(self) -> str:
         # "auto"/"ring" would consult the ambient mesh from inside the
@@ -101,7 +133,8 @@ class PipelinedBlocks(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
-                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 pad_mask: Optional[jnp.ndarray] = None,
+                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         Lc, D, H = self.num_layers, self.hidden_size, self.num_heads
         assert D == x.shape[-1], (D, x.shape)
         Dh = D // H
@@ -132,6 +165,12 @@ class PipelinedBlocks(nn.Module):
         from ..parallel.ring import current_mesh
         mesh = current_mesh()
         S = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if self.decode and not self.is_initializing():
+            if S > 1:
+                raise ValueError(
+                    "KV-cache decode is not available under a pipe > 1 "
+                    "mesh; generate on a {data}-only mesh")
+            return self._decode(lp, x, pad_mask, cache_index)
         if S <= 1 or self.is_initializing():
             # init traces with a tiny dummy batch that can't be chunked;
             # param shapes are identical either way.
@@ -146,6 +185,64 @@ class PipelinedBlocks(nn.Module):
             x, _ = jax.lax.scan(layer, x, lp)
             return x
         return self._gpipe(mesh, S, lp, x, pad_mask)
+
+    def _decode(self, lp, x, pad_mask, cache_index):
+        """KV-cache generation over the stacked layers: a full-length call
+        is the PREFILL (normal causal scan that also stores every layer's
+        K/V, [Lc, B, H, Lmax, Dh]); an L==1 call writes position
+        ``cache_index`` in every layer's cache and attends the single
+        query to the live prefix — mirroring backbone.SelfAttention's
+        decode contract for named blocks."""
+        B, L, D = x.shape
+        H = self.num_heads
+
+        def _no_prefill():
+            raise ValueError("single-token decode before prefill: call the "
+                             "model once at full length first")
+
+        if L > 1:  # prefill
+            if self.has_variable("cache", "key"):
+                # the named-blocks contract (backbone.py): full length is
+                # prefill, one token is a step — anything else is a bug;
+                # silently re-prefilling at a shorter L would clamp later
+                # cache writes into garbage continuations
+                Lmax = self.get_variable("cache", "key").shape[3]
+                if L != Lmax:
+                    raise ValueError(
+                        f"decode calls take the full length ({Lmax}, "
+                        f"prefill) or a single token, got {L}")
+
+            def layer(h, one):
+                out, kv = block_fwd(one, h, pad_mask, num_heads=H,
+                                    dtype=self.dtype, causal=True,
+                                    attention_impl=self._impl(),
+                                    return_kv=True)
+                return out, kv
+
+            x, (ks, vs) = jax.lax.scan(layer, x, lp)
+            self.variable("cache", "key", lambda: ks).value = ks
+            self.variable("cache", "value", lambda: vs).value = vs
+            return x
+        if cache_index is None:
+            raise ValueError("single-token decode needs cache_index")
+        ck = self.variable("cache", "key", _no_prefill)
+        cv = self.variable("cache", "value", _no_prefill)
+        Lmax = ck.value.shape[3]
+        idx = jnp.asarray(cache_index, jnp.int32)
+        live = jnp.broadcast_to(
+            (jnp.arange(Lmax) <= idx).astype(jnp.int32)[None], (B, Lmax))
+        if pad_mask is not None:
+            live = live * pad_mask
+
+        def layer(h, xs):
+            one, k_l, v_l = xs
+            out, k_l, v_l = block_decode_step(
+                one, h, k_l, v_l, idx, live, num_heads=H, dtype=self.dtype)
+            return out, (k_l, v_l)
+
+        x, (ks, vs) = jax.lax.scan(layer, x, (lp, ck.value, cv.value))
+        ck.value, cv.value = ks, vs
+        return x
 
     def _gpipe(self, mesh, S, lp, x, pad_mask):
         from jax import shard_map

@@ -203,16 +203,20 @@ def gpt2_decode(workload, params, ids: jnp.ndarray,
     full forward per position — the reference implementation the cache path
     is tested against."""
     pick = _next_token_fn(temperature, top_k, top_p, rng)
+    if getattr(workload.model, "scan_layers", False):
+        from ..parallel.ring import current_mesh
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+            # pipelined stages have no cache path; the gpipe full-recompute
+            # forward decodes identically, just O(L^2) per token (keeps
+            # --pipe N --eval_decode training runs alive)
+            use_cache = False
     # Inference never drops MoE tokens (capacity competition is a training
     # device; per-token top-k routing at decode time is exact and makes the
     # cached and uncached paths bit-identical — models/moe.py).
     model = workload.model.clone(moe_no_drop=True)
     B, L = ids.shape
     pad = jnp.ones_like(ids)
-
-    if getattr(model, "scan_layers", False):
-        use_cache = False  # stacked blocks have no KV-cache path yet;
-        # full-recompute greedy is identical output, just O(L^2) per token
 
     if not use_cache:
         def body(i, ids):

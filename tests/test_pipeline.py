@@ -130,3 +130,29 @@ def test_pipe_without_scan_layers_rejected():
     ns = run_train.create_parser().parse_args(["--pipe", "4"])
     with pytest.raises(SystemExit, match="scan_layers"):
         run_train.main(ns)
+
+
+def test_pipe_mesh_decode_falls_back_to_recompute(tmp_path):
+    """--pipe N --eval_decode must keep working: under a pipe > 1 mesh the
+    sampler falls back to the gpipe full-recompute forward instead of
+    crashing on the (unavailable) cache path."""
+    import numpy as np
+
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.models.sampling import gpt2_decode
+    from distributed_pipeline_tpu.parallel import make_mesh
+
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=4, num_heads=2, dtype="float32", scan_layers=True)
+    params = wl.init_params(jax.random.PRNGKey(0))
+    batch = next(load_data_from_args(
+        "valid", batch_size=8, dataset="synthetic-lm", seq_len=16,
+        vocab_size=64, seed=0, deterministic=True))
+    ids = jnp.asarray(batch["input_ids"])
+    ref = gpt2_decode(wl, params, ids, 8)  # no mesh: cache path
+    mesh = make_mesh(dp=2, pipe=4)
+    with mesh:
+        pred = gpt2_decode(wl, params, ids, 8)  # pipe mesh: recompute path
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(pred))
