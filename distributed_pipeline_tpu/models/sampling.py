@@ -1,4 +1,4 @@
-"""Inference: DiffuSeq reverse-process sampling and GPT-2 greedy decoding.
+"""Inference: DiffuSeq reverse-process sampling and GPT-2 decoding.
 
 The reference scaffold trains models but ships no way to USE a checkpoint
 (no sampling/generation code anywhere in ``/root/reference``); this module
@@ -9,8 +9,11 @@ exceeds it so checkpoints are consumable artifacts:
   noising" mirrored at inference), with DiffuSeq's clamping trick (project
   each x0 estimate onto the nearest word embedding through the tied
   rounding head) and step-striding for fast sampling.
-* :func:`gpt2_greedy_decode` — greedy autoregressive continuation of a
-  prompt prefix (full forward per position; seq lens here are short).
+* :func:`diffuseq_sample_mbr` — minimum-Bayes-risk consensus decoding over
+  S independent samples (the DiffuSeq paper's own scheme).
+* :func:`gpt2_decode` — KV-cache autoregressive continuation of a prompt
+  prefix: greedy by default, temperature / top-k / nucleus sampling
+  optional; works for named-blocks and stacked (scan_layers) models.
 * :func:`make_decode_callback` — wires either into ``TrainLoop``'s
   ``eval_callbacks`` hook (reference trainer.py:184-191 runs callbacks on
   rank 0 at eval intervals), logging ``decode_acc`` so training runs report
