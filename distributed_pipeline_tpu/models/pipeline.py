@@ -23,9 +23,13 @@ Design:
   them back across the pipe axis. Bubble ticks compute on clamped garbage
   and are masked out of the output — compute stays uniform across devices
   (SPMD cannot branch per stage).
-* **Composition, v1 scope** — composes with ``data``/``expert`` batch
-  sharding. ``fsdp``/``tensor``/``sequence`` > 1 alongside ``pipe`` > 1 is
-  rejected (weight gathering inside stages and ring-in-stage come later);
+* **Composition** — composes with ``data``/``expert`` batch sharding AND
+  with ``fsdp`` (ZeRO-3-inside-PP: each stage's weight slice shards over
+  the fsdp axis on its embed dim, is all-gathered before the stage's layer
+  scan, and the gather's AD transpose reduce-scatters the weight grads back
+  to the shard; fsdp ranks consume distinct batch shards). ``tensor``/
+  ``sequence`` > 1 alongside ``pipe`` > 1 is still rejected (ring-in-stage
+  and in-stage TP come later);
   MoE is not yet available in stacked mode (the factory rejects it).
   KV-cache decode works in stacked mode at ``pipe == 1`` (``decode=True``,
   mirroring backbone.SelfAttention's contract); under ``pipe > 1`` the
@@ -49,6 +53,20 @@ from ..ops import dot_product_attention
 from .backbone import EMBED, HEADS, KV, MLP, _dense_init
 
 LAYERS = "layers"
+
+# Logical axes of every stacked block weight — single source of truth for
+# the init-time with_logical_partitioning annotations AND the runtime
+# shard_map specs in _gpipe (fsdp shards the EMBED dim, pipe the LAYERS dim).
+STACKED_AXES = {
+    "ln1_scale": (LAYERS, None),
+    "ln1_bias": (LAYERS, None),
+    "qkv": (LAYERS, EMBED, None, HEADS, KV),
+    "out": (LAYERS, HEADS, KV, EMBED),
+    "ln2_scale": (LAYERS, None),
+    "ln2_bias": (LAYERS, None),
+    "wi": (LAYERS, EMBED, MLP),
+    "wo": (LAYERS, MLP, EMBED),
+}
 
 __all__ = ["PipelinedBlocks", "block_fwd"]
 
@@ -138,29 +156,20 @@ class PipelinedBlocks(nn.Module):
         Lc, D, H = self.num_layers, self.hidden_size, self.num_heads
         assert D == x.shape[-1], (D, x.shape)
         Dh = D // H
-        p = functools.partial(self.param)
-        lp = {
-            "ln1_scale": p("ln1_scale", nn.with_logical_partitioning(
-                nn.initializers.ones, (LAYERS, None)), (Lc, D), jnp.float32),
-            "ln1_bias": p("ln1_bias", nn.with_logical_partitioning(
-                nn.initializers.zeros, (LAYERS, None)), (Lc, D), jnp.float32),
-            "qkv": p("qkv", nn.with_logical_partitioning(
-                _dense_init(D), (LAYERS, EMBED, None, HEADS, KV)),
-                (Lc, D, 3, H, Dh), jnp.float32),
-            "out": p("out", nn.with_logical_partitioning(
-                _dense_init(D), (LAYERS, HEADS, KV, EMBED)),
-                (Lc, H, Dh, D), jnp.float32),
-            "ln2_scale": p("ln2_scale", nn.with_logical_partitioning(
-                nn.initializers.ones, (LAYERS, None)), (Lc, D), jnp.float32),
-            "ln2_bias": p("ln2_bias", nn.with_logical_partitioning(
-                nn.initializers.zeros, (LAYERS, None)), (Lc, D), jnp.float32),
-            "wi": p("wi", nn.with_logical_partitioning(
-                _dense_init(D), (LAYERS, EMBED, MLP)),
-                (Lc, D, 4 * D), jnp.float32),
-            "wo": p("wo", nn.with_logical_partitioning(
-                _dense_init(4 * D), (LAYERS, MLP, EMBED)),
-                (Lc, 4 * D, D), jnp.float32),
+        shapes = {
+            "ln1_scale": (nn.initializers.ones, (Lc, D)),
+            "ln1_bias": (nn.initializers.zeros, (Lc, D)),
+            "qkv": (_dense_init(D), (Lc, D, 3, H, Dh)),
+            "out": (_dense_init(D), (Lc, H, Dh, D)),
+            "ln2_scale": (nn.initializers.ones, (Lc, D)),
+            "ln2_bias": (nn.initializers.zeros, (Lc, D)),
+            "wi": (_dense_init(D), (Lc, D, 4 * D)),
+            "wo": (_dense_init(4 * D), (Lc, 4 * D, D)),
         }
+        lp = {
+            name: self.param(name, nn.with_logical_partitioning(
+                init, STACKED_AXES[name]), shape, jnp.float32)
+            for name, (init, shape) in shapes.items()}
 
         from ..parallel.ring import current_mesh
         mesh = current_mesh()
@@ -244,20 +253,26 @@ class PipelinedBlocks(nn.Module):
         ck.value, cv.value = ks, vs
         return x
 
+    # Which dim of each stacked weight carries the EMBED logical name —
+    # the dim FSDP shards (parallel/sharding.py LOGICAL_RULES: embed->fsdp).
+    # LayerNorm params have no embed dim and stay replicated over fsdp.
+    _FSDP_DIM = {k: axes.index(EMBED) for k, axes in STACKED_AXES.items()
+                 if EMBED in axes}
+
     def _gpipe(self, mesh, S, lp, x, pad_mask):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        for ax in ("fsdp", "tensor", "sequence"):
+        for ax in ("tensor", "sequence"):
             if mesh.shape[ax] > 1:
                 raise ValueError(
-                    f"pipeline parallelism v1 composes with data/expert "
+                    f"pipeline parallelism v1 composes with data/fsdp/expert "
                     f"axes only; mesh has {ax}={mesh.shape[ax]}")
         if self.num_layers % S:
             raise ValueError(f"num_layers {self.num_layers} not divisible "
                              f"by pipe axis {S}")
         B = x.shape[0]
-        batch_axes = tuple(a for a in ("data", "expert")
+        batch_axes = tuple(a for a in ("data", "fsdp", "expert")
                            if mesh.shape[a] > 1)
         n_b = 1
         for a in batch_axes:
@@ -266,20 +281,33 @@ class PipelinedBlocks(nn.Module):
             # raising beats silently replicating the batch over a dropped
             # axis (which would hide the misconfiguration as 1/n throughput)
             raise ValueError(
-                f"global batch {B} not divisible by data x expert axes "
-                f"product {n_b}")
+                f"global batch {B} not divisible by data x fsdp x expert "
+                f"axes product {n_b}")
         M = self.pp_chunks
         if (B // n_b) % M:
             raise ValueError(
                 f"per-shard batch {B // n_b} not divisible by pp_chunks {M}")
-        bspec = P(batch_axes or None)
-        pspec = jax.tree_util.tree_map(
-            lambda a: P(*(("pipe",) + (None,) * (a.ndim - 1))), lp)
+        # ZeRO-3-inside-PP: each stage's weight slice additionally shards
+        # over fsdp on its embed dim (when divisible — mirroring
+        # sharding.param_shardings' fallback), is all-gathered before the
+        # layer scan, and AD's transpose reduce-scatters the weight grads
+        # back to the shard. FSDP ranks consume distinct batch shards.
+        F = mesh.shape["fsdp"]
+        gather = {k: d for k, d in self._FSDP_DIM.items()
+                  if F > 1 and lp[k].shape[d] % F == 0}
+
+        def wspec(name, a):
+            dims = ["pipe"] + [None] * (a.ndim - 1)
+            if name in gather:
+                dims[gather[name]] = "fsdp"
+            return P(*dims)
+
+        pspec = {k: wspec(k, a) for k, a in lp.items()}
         x3 = P(batch_axes or None, None, None)
         m2 = P(batch_axes or None, None)
 
         fn = shard_map(
-            functools.partial(self._schedule, M=M),
+            functools.partial(self._schedule, M=M, gather=gather),
             mesh=mesh,
             in_specs=(pspec, x3, m2),
             out_specs=x3,
@@ -288,8 +316,15 @@ class PipelinedBlocks(nn.Module):
             pad_mask = jnp.ones(x.shape[:2], jnp.int32)
         return fn(lp, x, pad_mask)
 
-    def _schedule(self, lp_local, x_local, mask_local, *, M: int):
-        """Per-device GPipe schedule; lp_local holds THIS stage's layers."""
+    def _schedule(self, lp_local, x_local, mask_local, *, M: int,
+                  gather: Dict[str, int]):
+        """Per-device GPipe schedule; lp_local holds THIS stage's layers
+        (fsdp-sharded weights are all-gathered here; the transpose of the
+        gather reduce-scatters their grads — ZeRO-3 semantics)."""
+        lp_local = {
+            k: (jax.lax.all_gather(v, "fsdp", axis=gather[k], tiled=True)
+                if k in gather else v)
+            for k, v in lp_local.items()}
         S = jax.lax.psum(1, "pipe")
         sid = jax.lax.axis_index("pipe")
         B, L, D = x_local.shape

@@ -92,10 +92,42 @@ def test_gpipe_loss_invariant_vs_pure_dp(tmp_path, fam):
     assert losses["dp"][1] < losses["dp"][0]  # and it actually learns
 
 
+def test_gpipe_loss_invariant_vs_pure_dp_with_fsdp(tmp_path):
+    """pipe x fsdp (ZeRO-3-inside-PP): identical params + batch give the
+    same loss on {dp:8} as on {fsdp:2, pipe:4} — stage weights sharded over
+    fsdp on the embed dim, gathered in-stage, grads reduce-scattered. Two
+    steps deep so the backward/optimizer path is covered too."""
+    wl = stacked_workload("gpt2")
+    batch = next(load_data_from_args("train", batch_size=8,
+                                     dataset="synthetic-lm", seq_len=16,
+                                     vocab_size=64, seed=3))
+    losses = {}
+    for tag, axes in (("dp", dict(dp=8)), ("ppfsdp", dict(dp=1, fsdp=2,
+                                                          pipe=4))):
+        loop = TrainLoop(model=wl, data=iter([batch]), batch_size=8,
+                         lr=1e-3, ema_rate="0.9", learning_steps=10,
+                         log_interval=10 ** 6, save_interval=10 ** 9,
+                         mesh=make_mesh(**axes),
+                         checkpoint_dir=str(tmp_path / tag), seed=5)
+        if tag == "ppfsdp":
+            # the fsdp x pipe mesh must actually shard the stacked weights
+            # on BOTH axes: [layers/pipe, embed/fsdp, ...]
+            qkv = loop.state.params["params"]["backbone"]["blocks"]["qkv"]
+            spec = qkv.sharding.spec
+            assert spec[0] == "pipe" and spec[1] == "fsdp", spec
+        l1 = float(loop.run_step(batch)["loss"])
+        l2 = float(loop.run_step(batch)["loss"])
+        losses[tag] = (l1, l2)
+    np.testing.assert_allclose(losses["dp"][0], losses["ppfsdp"][0],
+                               rtol=2e-5)
+    np.testing.assert_allclose(losses["dp"][1], losses["ppfsdp"][1],
+                               rtol=2e-5)
+
+
 def test_gpipe_rejects_unsupported_axes():
     wl = stacked_workload()
     batch = jax.tree_util.tree_map(jnp.asarray, wl.example_batch(8))
-    mesh = make_mesh(dp=1, fsdp=2, pipe=4)
+    mesh = make_mesh(dp=1, tensor=2, pipe=4)
     params = wl.init_params(jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="pipeline parallelism v1"):
         with mesh:
