@@ -65,10 +65,17 @@ class DiffuSeqModel(nn.Module):
     pp_chunks: int = 4
 
     def setup(self) -> None:
+        # dim1 is the low-dim diffusion embedding SPACE (emb_dim), not the
+        # model hidden dim — annotating it EMBED would shard it over fsdp
+        # and every [B, L, emb] activation (x_start/x_t/noise) would inherit
+        # a last-dim fsdp sharding that fights their batch sharding
+        # (data x fsdp on dim0): the SPMD partitioner then falls back to
+        # "Involuntary full rematerialization" (full replication) on every
+        # reshard. The table still shards over vocab -> tensor.
         self.word_emb = nn.Embed(
             self.vocab_size, self.emb_dim,
             embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("vocab", EMBED)),
+                nn.initializers.normal(0.02), ("vocab", None)),
             param_dtype=jnp.float32, name="word_emb")
         self.in_proj = nn.Dense(
             self.hidden_size, kernel_init=nn.with_logical_partitioning(

@@ -319,12 +319,20 @@ class PipelinedBlocks(nn.Module):
     def _schedule(self, lp_local, x_local, mask_local, *, M: int,
                   gather: Dict[str, int]):
         """Per-device GPipe schedule; lp_local holds THIS stage's layers
-        (fsdp-sharded weights are all-gathered here; the transpose of the
-        gather reduce-scatters their grads — ZeRO-3 semantics)."""
-        lp_local = {
-            k: (jax.lax.all_gather(v, "fsdp", axis=gather[k], tiled=True)
-                if k in gather else v)
-            for k, v in lp_local.items()}
+        (fsdp-sharded weights are all-gathered before use; the transpose of
+        the gather reduce-scatters their grads — ZeRO-3 semantics).
+
+        Gather placement: without remat, the whole stage stack is gathered
+        once up front (cheapest traffic — one gather for all ticks). With
+        remat, gathering happens per-layer INSIDE the checkpointed scan body
+        so the fully-gathered weights are rematerialized rather than saved
+        as residuals: peak resident weight memory stays at the 1/F shard,
+        at the price of re-gathering each layer in the backward pass."""
+        if not self.remat:
+            lp_local = {
+                k: (jax.lax.all_gather(v, "fsdp", axis=gather[k], tiled=True)
+                    if k in gather else v)
+                for k, v in lp_local.items()}
         S = jax.lax.psum(1, "pipe")
         sid = jax.lax.axis_index("pipe")
         B, L, D = x_local.shape
@@ -335,6 +343,13 @@ class PipelinedBlocks(nn.Module):
 
         def apply_stage(h, mask):
             def layer(h, one):
+                if self.remat:
+                    # per-layer slices lost the leading layers dim -> axis-1
+                    one = {
+                        k: (jax.lax.all_gather(v, "fsdp",
+                                               axis=gather[k] - 1, tiled=True)
+                            if k in gather else v)
+                        for k, v in one.items()}
                 return block_fwd(one, h, mask, num_heads=self.num_heads,
                                  dtype=self.dtype, causal=self.causal,
                                  attention_impl=self._impl()), None

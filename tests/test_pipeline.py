@@ -41,11 +41,11 @@ def test_block_fwd_matches_flax_block():
                                rtol=2e-5, atol=2e-5)
 
 
-def stacked_workload(fam="gpt2"):
+def stacked_workload(fam="gpt2", remat=False):
     return create_model_from_config(
         model_family=fam, vocab_size=64, seq_len=16, hidden_size=32,
         num_layers=4, num_heads=2, diffusion_steps=50, dtype="float32",
-        scan_layers=True)
+        scan_layers=True, remat=remat)
 
 
 @pytest.mark.parametrize("fam", ["gpt2", "diffuseq"])
@@ -92,12 +92,15 @@ def test_gpipe_loss_invariant_vs_pure_dp(tmp_path, fam):
     assert losses["dp"][1] < losses["dp"][0]  # and it actually learns
 
 
-def test_gpipe_loss_invariant_vs_pure_dp_with_fsdp(tmp_path):
+@pytest.mark.parametrize("remat", [False, True])
+def test_gpipe_loss_invariant_vs_pure_dp_with_fsdp(tmp_path, remat):
     """pipe x fsdp (ZeRO-3-inside-PP): identical params + batch give the
     same loss on {dp:8} as on {fsdp:2, pipe:4} — stage weights sharded over
     fsdp on the embed dim, gathered in-stage, grads reduce-scattered. Two
-    steps deep so the backward/optimizer path is covered too."""
-    wl = stacked_workload("gpt2")
+    steps deep so the backward/optimizer path is covered too. remat=True
+    additionally covers the per-layer gather inside the checkpointed scan
+    body (weights rematerialized, not saved as residuals)."""
+    wl = stacked_workload("gpt2", remat=remat)
     batch = next(load_data_from_args("train", batch_size=8,
                                      dataset="synthetic-lm", seq_len=16,
                                      vocab_size=64, seed=3))
