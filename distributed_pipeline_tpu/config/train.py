@@ -92,6 +92,13 @@ class ModelSettings(S):
                                  "fast compiles for deep models)")
     pp_chunks: int = _(4, "GPipe microchunks per per-shard batch "
                           "(pipeline parallelism; bubble = (S-1)/(chunks+S-1))")
+    pp_schedule: Literal["1f1b", "gpipe"] = _(
+        "1f1b", "pipeline training schedule: 1f1b streams each chunk's "
+                "backward as soon as its forward clears the last stage "
+                "(peak stash <= 2S-1 chunks, so pp_chunks can grow to "
+                "shrink the bubble); gpipe differentiates through the "
+                "forward-only schedule (simpler, but activation residuals "
+                "scale with pp_chunks)")
 
 
 class MeshSettings(S):

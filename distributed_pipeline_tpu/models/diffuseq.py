@@ -63,6 +63,7 @@ class DiffuSeqModel(nn.Module):
     moe_no_drop: bool = False
     scan_layers: bool = False
     pp_chunks: int = 4
+    pp_schedule: str = "1f1b"  # training schedule under a pipe > 1 mesh
 
     def setup(self) -> None:
         # dim1 is the low-dim diffusion embedding SPACE (emb_dim), not the
@@ -148,6 +149,15 @@ def diffuseq_losses(model: DiffuSeqModel, schedule: DiffusionSchedule,
     concrete ``compute_losses`` the reference declares as a user hook
     (``utils/trainer.py:23-25``). Returns a dict whose ``"loss"`` entry is
     optimized; the rest are logged (reference ``log_loss_dict`` hook)."""
+    from ..parallel.ring import current_mesh
+
+    mesh = current_mesh()
+    if (mesh is not None and mesh.shape.get("pipe", 1) > 1
+            and model.scan_layers and model.pp_schedule == "1f1b"):
+        # training under a pipe mesh: the 1F1B streaming schedule computes
+        # loss AND grads in one pass (models/schedule_1f1b.py)
+        from .schedule_1f1b import diffuseq_1f1b_losses
+        return diffuseq_1f1b_losses(model, schedule, params, batch, rng)
     ids = batch["input_ids"]
     tgt_mask = batch["input_mask"].astype(jnp.float32)   # diffused span
     pad_mask = batch["pad_mask"]

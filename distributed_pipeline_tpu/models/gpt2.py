@@ -43,6 +43,7 @@ class GPT2Model(nn.Module):
     moe_no_drop: bool = False
     scan_layers: bool = False
     pp_chunks: int = 4
+    pp_schedule: str = "1f1b"  # training schedule under a pipe > 1 mesh
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
@@ -90,6 +91,15 @@ def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
     ``compute_losses`` path (reference hook, utils/trainer.py:23-25).
     ``rng`` is unused but kept for loss-fn signature uniformity."""
     del rng
+    from ..parallel.ring import current_mesh
+
+    mesh = current_mesh()
+    if (mesh is not None and mesh.shape.get("pipe", 1) > 1
+            and model.scan_layers and model.pp_schedule == "1f1b"):
+        # training under a pipe mesh: the 1F1B streaming schedule computes
+        # loss AND grads in one pass (models/schedule_1f1b.py)
+        from .schedule_1f1b import gpt2_1f1b_losses
+        return gpt2_1f1b_losses(model, params, batch)
     ids = batch["input_ids"]
     pad_mask = batch["pad_mask"]
     loss_mask = (batch["input_mask"] * pad_mask)[:, 1:].astype(jnp.float32)

@@ -15,14 +15,22 @@ Design:
   ``[s*L/S, (s+1)*L/S)``. With ``pipe == 1`` this degrades to a plain
   ``lax.scan`` over layers — the "scan_layers" mode, which also collapses
   compile time for deep models (one traced block instead of num_layers).
-* **GPipe schedule** — the per-device batch splits into ``pp_chunks``
-  equal microchunks; at tick t, stage 0 ingests chunk t while stage s
-  applies its layers to the chunk received from stage s-1 and forwards the
-  result via a non-cyclic ``ppermute``. After ``pp_chunks + S - 1`` ticks
-  the last stage holds every output chunk; one masked ``psum`` replicates
-  them back across the pipe axis. Bubble ticks compute on clamped garbage
-  and are masked out of the output — compute stays uniform across devices
-  (SPMD cannot branch per stage).
+* **GPipe schedule** (this module) — the per-device batch splits into
+  ``pp_chunks`` equal microchunks; at tick t, stage 0 ingests chunk t
+  while stage s applies its layers to the chunk received from stage s-1
+  and forwards the result via a non-cyclic ``ppermute``. After
+  ``pp_chunks + S - 1`` ticks the last stage holds every output chunk; one
+  masked ``psum`` replicates them back across the pipe axis. Bubble ticks
+  compute on clamped garbage and are masked out of the output — compute
+  stays uniform across devices (SPMD cannot branch per stage). Bubble
+  fraction (S-1)/(M+S-1): growing M shrinks it, but reverse-mode AD
+  through this forward-only stream saves every tick's per-layer residuals,
+  so activation memory GROWS with M. That tradeoff is why training under a
+  pipe mesh defaults to the **1F1B schedule** (models/schedule_1f1b.py,
+  ``pp_schedule="1f1b"``): a streaming custom_vjp whose stash holds
+  min(M, 2S-1) chunk inputs — constant in M — so M can grow to shrink the
+  bubble. This module remains the forward/eval path (sampling under a pipe
+  mesh) and the ``pp_schedule="gpipe"`` training fallback.
 * **Composition** — composes with ``data``/``expert`` batch sharding AND
   with ``fsdp`` (ZeRO-3-inside-PP: each stage's weight slice shards over
   the fsdp axis on its embed dim, is all-gathered before the stage's layer
@@ -126,6 +134,40 @@ def block_decode_step(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     o = dot_product_attention(q, ck, cv, live, causal=False, impl="xla")
     x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
     return _block_mlp(lp, x, dtype), ck, cv
+
+
+def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
+                attention_impl: str, remat: bool, gather: Dict[str, int]):
+    """Apply one pipeline stage's stacked layer slice to ``h``:
+    ``block_fwd`` scanned over the leading layers dim. ``gather`` maps
+    weight names to their fsdp-sharded dim (STACKED_AXES embed dims);
+    non-remat gathers the whole stage stack once up front, remat gathers
+    per-layer INSIDE the checkpointed body so gathered weights are
+    rematerialized in the backward instead of saved as residuals. Shared
+    by the GPipe schedule below and the 1F1B schedule
+    (models/schedule_1f1b.py) so the two paths cannot diverge."""
+    impl = attention_impl if attention_impl in ("xla", "pallas") else "xla"
+    if gather and not remat:
+        lp_local = {
+            k: (jax.lax.all_gather(v, "fsdp", axis=gather[k], tiled=True)
+                if k in gather else v)
+            for k, v in lp_local.items()}
+        gather = {}
+
+    def layer(h, one):
+        if gather:
+            # per-layer slices lost the leading layers dim -> axis-1
+            one = {
+                k: (jax.lax.all_gather(v, "fsdp", axis=gather[k] - 1,
+                                       tiled=True) if k in gather else v)
+                for k, v in one.items()}
+        return block_fwd(one, h, mask, num_heads=num_heads, dtype=dtype,
+                         causal=causal, attention_impl=impl), None
+
+    if remat:
+        layer = jax.checkpoint(layer, prevent_cse=False)
+    h, _ = jax.lax.scan(layer, h, lp_local)
+    return h
 
 
 class PipelinedBlocks(nn.Module):
@@ -323,16 +365,18 @@ class PipelinedBlocks(nn.Module):
         the gather reduce-scatters their grads — ZeRO-3 semantics).
 
         Gather placement: without remat, the whole stage stack is gathered
-        once up front (cheapest traffic — one gather for all ticks). With
-        remat, gathering happens per-layer INSIDE the checkpointed scan body
-        so the fully-gathered weights are rematerialized rather than saved
-        as residuals: peak resident weight memory stays at the 1/F shard,
-        at the price of re-gathering each layer in the backward pass."""
+        once up front — OUTSIDE the tick scan, one gather for all ticks
+        (stage_apply's own stage-wide gather would re-run per tick). With
+        remat, stage_apply gathers per-layer INSIDE the checkpointed scan
+        body so the fully-gathered weights are rematerialized rather than
+        saved as residuals: peak resident weight memory stays at the 1/F
+        shard, at the price of re-gathering each layer in the backward."""
         if not self.remat:
             lp_local = {
                 k: (jax.lax.all_gather(v, "fsdp", axis=gather[k], tiled=True)
                     if k in gather else v)
                 for k, v in lp_local.items()}
+            gather = {}
         S = jax.lax.psum(1, "pipe")
         sid = jax.lax.axis_index("pipe")
         B, L, D = x_local.shape
@@ -342,22 +386,10 @@ class PipelinedBlocks(nn.Module):
         perm = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1
 
         def apply_stage(h, mask):
-            def layer(h, one):
-                if self.remat:
-                    # per-layer slices lost the leading layers dim -> axis-1
-                    one = {
-                        k: (jax.lax.all_gather(v, "fsdp",
-                                               axis=gather[k] - 1, tiled=True)
-                            if k in gather else v)
-                        for k, v in one.items()}
-                return block_fwd(one, h, mask, num_heads=self.num_heads,
-                                 dtype=self.dtype, causal=self.causal,
-                                 attention_impl=self._impl()), None
-
-            if self.remat:
-                layer = jax.checkpoint(layer, prevent_cse=False)
-            h, _ = jax.lax.scan(layer, h, lp_local)
-            return h
+            return stage_apply(lp_local, h, mask, num_heads=self.num_heads,
+                               dtype=self.dtype, causal=self.causal,
+                               attention_impl=self._impl(),
+                               remat=self.remat, gather=gather)
 
         def tick(carry, t):
             recv, outs = carry

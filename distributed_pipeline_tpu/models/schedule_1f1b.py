@@ -1,0 +1,487 @@
+"""Interleaved 1F1B pipeline schedule: loss AND grads in one streaming pass.
+
+The GPipe schedule in models/pipeline.py is forward-only — reverse-mode AD
+replays it backwards, which works but makes activation memory scale with the
+chunk count M: the scan's residuals hold every chunk's per-layer
+intermediates until the whole forward finishes. 1F1B (PipeDream-flush /
+Megatron's non-interleaved schedule) starts each chunk's backward as soon as
+its forward clears the last stage, so a stage only ever holds the few chunks
+in flight between its forward and its backward — letting M grow (which is
+exactly the knob that shrinks the bubble) without growing memory.
+
+AD cannot express that interleave from the outside: the backward of a
+``shard_map``-ed forward runs strictly after the downstream loss. So this
+module IS the backward — a ``jax.custom_vjp`` whose forward pass runs one
+combined scan in which every tick does one Forward slot and one Backward
+slot per stage, and whose vjp just scales the already-accumulated grads:
+
+* tick ``t``, stage ``s`` **F slot**: forward chunk ``f = t - s`` (entering
+  from the previous stage via ``ppermute``, or from ``pre_fn`` on stage 0)
+  and stash the chunk's stage INPUT in a ring buffer.
+* the LAST stage immediately closes the loop: ``head_fn`` (loss head) runs
+  on the chunk it just finished, and its vjp seeds the cotangent stream.
+* tick ``t``, stage ``s`` **B slot**: backward chunk ``b = t - 2(S-1) + s``
+  — recompute the stage forward from the stashed input under ``jax.vjp``,
+  apply the cotangent arriving from stage ``s+1`` (reverse ``ppermute``),
+  accumulate weight grads, and send the input-cotangent upstream. Stage 0
+  additionally backprops through ``pre_fn`` into the embedding weights and
+  any differentiable data inputs.
+
+Timing: chunk ``b``'s cotangent leaves stage ``s+1`` at tick ``t-1`` and is
+consumed by stage ``s`` at tick ``t`` — the schedule is SPMD-lockstep, every
+device runs the same program per tick. The run takes ``M + 2(S-1)`` ticks
+(vs GPipe's ``M + S - 1`` forward-only ticks, but each tick here carries
+both an F and a B compute slot, so total work matches forward+backward).
+In-flight chunks at stage ``s``: ``f - b + 1 = 2(S-1-s) + 1 <= 2S - 1`` —
+the stash ring holds ``min(M, 2S-1)`` chunk inputs, CONSTANT in M
+(``stash_size``; the lockstep price vs the textbook per-stage ``S - s``).
+
+ZeRO-3 composition: ``stage_fn`` all-gathers fsdp-sharded weights per layer
+inside its scan body, so ``jax.vjp(stage_fn)`` emits the matching
+reduce-scatter (``psum_scatter``) and weight grads come out fsdp-sharded
+with no extra plumbing.
+
+No reference counterpart (the reference is DDP-only, SURVEY.md §2.2); the
+spec is the 1F1B schedule of the PipeDream/Megatron literature, restated
+for SPMD + XLA collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.ring import current_mesh
+
+__all__ = ["pipelined_loss", "stash_size", "gpt2_1f1b_losses",
+           "diffuseq_1f1b_losses"]
+
+
+def stash_size(M: int, S: int) -> int:
+    """Ring-buffer slots needed for stage-input stashes: the largest
+    forward-to-backward distance in the lockstep schedule is 2(S-1) chunks
+    (stage 0), +1 for the chunk entering this tick — capped at M."""
+    return min(M, 2 * S - 1)
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_where(pred, t):
+    """NaN-safe masking: select, don't multiply (garbage ticks may produce
+    non-finite values; 0 * nan would leak them into the accumulators)."""
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(pred, g, jnp.zeros_like(g)), t)
+
+
+def _tree_zeros_of(struct):
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), struct)
+
+
+def _take(tree, i):
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+        tree)
+
+
+def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
+                   stage_fn: Callable, pre_fn: Callable, mask_fn: Callable,
+                   head_fn: Callable, lp_specs: Dict[str, Any]):
+    """Run the 1F1B schedule; returns ``(loss, metrics)``, differentiable
+    w.r.t. ``lp`` (stage weights), ``rest`` (embedding/head weights) and
+    ``diff`` (differentiable per-sample data, e.g. DiffuSeq's x_t/x_start).
+
+    * ``lp``: dict of stacked stage weights, sharded per ``lp_specs``
+      (``pipe`` on dim 0, optionally ``fsdp`` on an embed dim).
+    * ``rest``: pytree of replicated non-stage weights.
+    * ``diff`` / ``aux``: pytrees of ``[B, ...]`` batch arrays —
+      cotangents are produced for ``diff`` only. ``scalars``: replicated
+      precomputed scalars (e.g. global mask denominators) — global
+      reductions cannot be taken per-chunk, so the caller supplies them.
+    * ``stage_fn(lp_local, h, mask) -> h`` — this stage's layer stack
+      (collectives allowed: fsdp gathers live here).
+    * ``pre_fn(rest, diff_c, aux_c, scalars) -> h0`` — embedding for one
+      chunk. ``mask_fn(aux_c) -> pad-mask`` for the stage attention.
+    * ``head_fn(rest, h_out, diff_c, aux_c, scalars) -> (loss_sum,
+      metrics)`` — per-chunk LOSS CONTRIBUTION (a sum scaled by the global
+      denominator from ``scalars``; chunk contributions are summed across
+      chunks and devices). No collectives allowed in pre/mask/head (they
+      run under ``lax.cond``).
+
+    ``aux`` and ``scalars`` must not require gradients (they are closed
+    over, not differentiated; integer ids/masks and mask-derived
+    denominators qualify).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    S = mesh.shape["pipe"]
+    M = pp_chunks
+    if S < 2:
+        raise ValueError(f"1f1b schedule needs a pipe axis > 1, got {S}")
+    batch_axes = tuple(a for a in ("data", "fsdp", "expert")
+                       if mesh.shape[a] > 1)
+    n_b = 1
+    for a in batch_axes:
+        n_b *= mesh.shape[a]
+    B = jax.tree_util.tree_leaves(aux)[0].shape[0]
+    if B % n_b:
+        raise ValueError(f"global batch {B} not divisible by data x fsdp x "
+                         f"expert axes product {n_b}")
+    if (B // n_b) % M:
+        raise ValueError(f"per-shard batch {B // n_b} not divisible by "
+                         f"pp_chunks {M}")
+    K = stash_size(M, S)
+    T = M + 2 * (S - 1)
+
+    bspec = P(batch_axes or None)
+    rep = P()
+    body = functools.partial(
+        _schedule_body, S=S, M=M, K=K, T=T, stage_fn=stage_fn,
+        pre_fn=pre_fn, mask_fn=mask_fn, head_fn=head_fn,
+        gathered=frozenset(k for k, s in lp_specs.items() if "fsdp" in s))
+
+    fwd = shard_map(
+        body, mesh=mesh,
+        in_specs=(lp_specs, rep, bspec, bspec, rep),
+        out_specs=(rep, rep, lp_specs, rep, bspec),
+        check_vma=False)
+
+    @jax.custom_vjp
+    def run(lp_, rest_, diff_):
+        loss, metrics, _, _, _ = fwd(lp_, rest_, diff_, aux, scalars)
+        return loss, metrics
+
+    def run_fwd(lp_, rest_, diff_):
+        loss, metrics, d_lp, d_rest, d_diff = fwd(lp_, rest_, diff_, aux,
+                                                  scalars)
+        return (loss, metrics), (d_lp, d_rest, d_diff)
+
+    def run_bwd(res, cts):
+        d_lp, d_rest, d_diff = res
+        ct_loss, _ct_metrics = cts  # metrics are reporting-only sums
+        scale = lambda t: jax.tree_util.tree_map(
+            lambda g: g * ct_loss, t)
+        return scale(d_lp), scale(d_rest), scale(d_diff)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(lp, rest, diff)
+
+
+def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
+                   S, M, K, T, stage_fn, pre_fn, mask_fn, head_fn, gathered):
+    """Per-device combined F+B scan (module docstring). Runs inside
+    shard_map; ``lp_local`` is this stage's (possibly fsdp-sharded) layer
+    slice."""
+    sid = jax.lax.axis_index("pipe")
+    last = S - 1
+    perm_f = [(i, i + 1) for i in range(S - 1)]
+    perm_b = [(i + 1, i) for i in range(S - 1)]
+
+    chunk = lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:])
+    diff_c = jax.tree_util.tree_map(chunk, diff_local)
+    aux_c = jax.tree_util.tree_map(chunk, aux_local)
+
+    d0, a0 = _take(diff_c, jnp.int32(0)), _take(aux_c, jnp.int32(0))
+    h_struct = jax.eval_shape(pre_fn, rest, d0, a0, scalars)
+
+    def head_and_vjp(rest_, h_, dc_, ac_):
+        (lc, mc), hvjp = jax.vjp(
+            lambda r, h, d: head_fn(r, h, d, ac_, scalars),
+            rest_, h_, dc_)
+        d_rest_h, d_h_out, d_diff_h = hvjp(
+            (jnp.ones((), lc.dtype),
+             jax.tree_util.tree_map(jnp.zeros_like, mc)))
+        return lc, mc, d_rest_h, d_h_out, d_diff_h
+
+    def pre_vjp(rest_, dc_, ac_, seed):
+        _, pvjp = jax.vjp(
+            lambda r, d: pre_fn(r, d, ac_, scalars), rest_, dc_)
+        return pvjp(seed)
+
+    zeros_h = jnp.zeros(h_struct.shape, h_struct.dtype)
+    head_struct = jax.eval_shape(head_and_vjp, rest, zeros_h, d0, a0)
+    pre_struct = jax.eval_shape(pre_vjp, rest, d0, a0, zeros_h)
+
+    def tick(carry, t):
+        recv_f, recv_b, stash, d_lp, d_rest, d_diff, loss, metrics = carry
+        f = t - sid
+        b = t - 2 * (S - 1) + sid
+        fc = jnp.clip(f, 0, M - 1)
+        bc = jnp.clip(b, 0, M - 1)
+        vf = jnp.logical_and(f >= 0, f < M)
+        vb = jnp.logical_and(b >= 0, b < M)
+        dfc, afc = _take(diff_c, fc), _take(aux_c, fc)
+        dbc, abc = _take(diff_c, bc), _take(aux_c, bc)
+
+        # ---- F slot: forward chunk f through this stage (pre_fn only
+        # feeds stage 0 — cond skips its flops elsewhere; no collectives
+        # inside)
+        h0_f = jax.lax.cond(
+            jnp.equal(sid, 0),
+            lambda ops: pre_fn(ops[0], ops[1], ops[2], scalars),
+            lambda ops: zeros_h,
+            (rest, dfc, afc))
+        h_in = jnp.where(jnp.equal(sid, 0), h0_f, recv_f)
+        h_out = stage_fn(lp_local, h_in, mask_fn(afc))
+        slot_w = jnp.mod(fc, K)
+        prev = jax.lax.dynamic_index_in_dim(stash, slot_w, 0,
+                                            keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(vf, h_in, prev), slot_w, 0)
+
+        # ---- loss head: only the last stage's value is real (b == f
+        # there, so h_out IS chunk b's blocks output); lax.cond skips the
+        # flops elsewhere at runtime. No collectives inside.
+        lc, mc, d_rest_h, d_h_out, d_diff_h = jax.lax.cond(
+            jnp.equal(sid, last),
+            lambda ops: head_and_vjp(*ops),
+            lambda ops: _tree_zeros_of(head_struct),
+            (rest, h_out, dbc, abc))
+
+        # ---- B slot: backward chunk b — recompute from the stashed stage
+        # input under vjp (activation recompute: residual lifetime is one
+        # tick), consume the cotangent, stream its input-cotangent back.
+        cot_in = jnp.where(jnp.equal(sid, last), d_h_out, recv_b)
+        slot_r = jnp.mod(bc, K)
+        h_in_b = jax.lax.dynamic_index_in_dim(stash, slot_r, 0,
+                                              keepdims=False)
+        mask_b = mask_fn(abc)
+        _, svjp = jax.vjp(lambda w, h: stage_fn(w, h, mask_b),
+                          lp_local, h_in_b)
+        d_lp_c, d_h_in = svjp(cot_in)
+
+        d_rest_p, d_diff_p = jax.lax.cond(
+            jnp.equal(sid, 0),
+            lambda ops: pre_vjp(*ops),
+            lambda ops: _tree_zeros_of(pre_struct),
+            (rest, dbc, abc, d_h_in))
+
+        d_lp = _tree_add(d_lp, _tree_where(vb, d_lp_c))
+        d_rest = _tree_add(d_rest,
+                           _tree_where(vb, _tree_add(d_rest_h, d_rest_p)))
+        d_diff = jax.tree_util.tree_map(
+            lambda buf, g: buf.at[bc].add(jnp.where(vb, g,
+                                                    jnp.zeros_like(g))),
+            d_diff, _tree_add(d_diff_h, d_diff_p))
+        loss = loss + jnp.where(vb, lc, 0.0)
+        metrics = _tree_add(metrics, _tree_where(vb, mc))
+
+        send_f = jax.lax.ppermute(h_out, "pipe", perm_f)
+        send_b = jax.lax.ppermute(d_h_in, "pipe", perm_b)
+        return (send_f, send_b, stash, d_lp, d_rest, d_diff, loss,
+                metrics), None
+
+    # metrics carry structure: zeros of head_fn's metrics output
+    metrics0 = _tree_zeros_of(
+        jax.eval_shape(head_fn, rest, zeros_h, d0, a0, scalars)[1])
+    carry0 = (
+        zeros_h,                                          # recv_f
+        zeros_h,                                          # recv_b
+        jnp.zeros((K,) + h_struct.shape, h_struct.dtype),  # stash
+        jax.tree_util.tree_map(jnp.zeros_like, lp_local),  # d_lp
+        jax.tree_util.tree_map(jnp.zeros_like, rest),      # d_rest
+        jax.tree_util.tree_map(jnp.zeros_like, diff_c),    # d_diff [M,cb,..]
+        jnp.zeros((), jnp.float32),                        # loss
+        metrics0,
+    )
+    (_, _, _, d_lp, d_rest, d_diff, loss, metrics), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    # ---- cross-device reductions (outside lax.cond — collectives must run
+    # on every device). Gathered weights' fsdp reduce-scatter already
+    # happened inside svjp (the transpose of the per-layer all_gather);
+    # everything else sums explicitly.
+    batch_red = ("data", "expert")
+    d_lp = {k: jax.lax.psum(g if k in gathered else jax.lax.psum(g, "fsdp"),
+                            batch_red)
+            for k, g in d_lp.items()}
+    full_red = ("data", "fsdp", "expert", "pipe")
+    d_rest = jax.lax.psum(d_rest, full_red)
+    loss = jax.lax.psum(loss, full_red)
+    metrics = jax.lax.psum(metrics, full_red)
+    # diff cotangents: batch-sharded; only one stage produced each side's
+    # contribution (masked elsewhere) — psum over pipe merges pre+head parts
+    B_local = jax.tree_util.tree_leaves(aux_local)[0].shape[0]
+    d_diff = jax.tree_util.tree_map(
+        lambda a: jax.lax.psum(a, "pipe").reshape((B_local,) + a.shape[2:]),
+        d_diff)
+    return loss, metrics, d_lp, d_rest, d_diff
+
+
+# --------------------------------------------------------------------------
+# Family glue: GPT-2 and DiffuSeq objectives on the 1F1B engine. These
+# re-state each family's pre/head math as pure functions of the param trees
+# (numerics pinned against the flax modules by tests/test_pipeline.py).
+# --------------------------------------------------------------------------
+
+
+def _stage_fn_for(model, gather, causal: bool):
+    """This stage's layer stack as a pure fn: pipeline.stage_apply (the
+    same body the GPipe schedule uses — the gather/remat/impl policy lives
+    in ONE place) with the model's static attributes bound. The fsdp
+    gathers inside make jax.vjp emit the matching reduce-scatter (ZeRO-3
+    grad semantics)."""
+    from .pipeline import stage_apply
+
+    return functools.partial(
+        stage_apply, num_heads=model.num_heads, dtype=model.dtype,
+        causal=causal, attention_impl=model.attention_impl,
+        remat=model.remat, gather=gather)
+
+
+def _lp_specs_and_gather(mesh, lp):
+    """shard_map specs for the stacked stage weights: pipe on the layers
+    dim, fsdp on the embed dim when divisible — the _gpipe rules."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pipeline import PipelinedBlocks
+
+    F = mesh.shape["fsdp"]
+    gather = {k: d for k, d in PipelinedBlocks._FSDP_DIM.items()
+              if F > 1 and lp[k].shape[d] % F == 0}
+
+    def wspec(name, a):
+        dims = ["pipe"] + [None] * (a.ndim - 1)
+        if name in gather:
+            dims[gather[name]] = "fsdp"
+        return P(*dims)
+
+    return {k: wspec(k, a) for k, a in lp.items()}, gather
+
+
+def _check_pipe_mesh(mesh):
+    for ax in ("tensor", "sequence"):
+        if mesh.shape[ax] > 1:
+            raise ValueError(
+                f"pipeline parallelism v1 composes with data/fsdp/expert "
+                f"axes only; mesh has {ax}={mesh.shape[ax]}")
+
+
+def gpt2_1f1b_losses(model, params, batch) -> Dict[str, jnp.ndarray]:
+    """GPT-2 next-token CE through the 1F1B schedule — same objective and
+    metrics as gpt2.gpt2_losses, computed per chunk at the last stage."""
+    from .pipeline import _layernorm
+    from ..ops.xent import token_cross_entropy
+
+    mesh = current_mesh()
+    _check_pipe_mesh(mesh)
+    p = params["params"]
+    lp = dict(p["backbone"]["blocks"])
+    rest = {"word_emb": p["word_emb"]["embedding"],
+            "pos_emb": p["pos_emb"],
+            "ln_f_scale": p["backbone"]["ln_f"]["scale"],
+            "ln_f_bias": p["backbone"]["ln_f"]["bias"]}
+    ids = batch["input_ids"]
+    pad_mask = batch["pad_mask"]
+    loss_mask = (batch["input_mask"] * pad_mask)[:, 1:].astype(jnp.float32)
+    inv_denom = 1.0 / jnp.maximum(loss_mask.sum(), 1.0)
+    aux = {"ids": ids, "pad": pad_mask, "lm": loss_mask}
+    dtype = model.dtype
+    L = ids.shape[1]
+
+    def pre_fn(r, dc, ac, sc):
+        del dc, sc
+        return (r["word_emb"][ac["ids"]]
+                + r["pos_emb"][None, :L]).astype(dtype)
+
+    def head_fn(r, h, dc, ac, sc):
+        del dc
+        h = _layernorm(h, r["ln_f_scale"], r["ln_f_bias"]).astype(dtype)
+        logits = jnp.einsum("bld,vd->blv", h,
+                            r["word_emb"].astype(dtype))[:, :-1]
+        targets = ac["ids"][:, 1:]
+        nll = token_cross_entropy(logits, targets)
+        lm = ac["lm"]
+        loss_sum = (nll * lm).sum() * sc["inv_denom"]
+        hit = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+        return loss_sum.astype(jnp.float32), {
+            "acc": ((hit * lm).sum() * sc["inv_denom"]).astype(jnp.float32)}
+
+    lp_specs, gather = _lp_specs_and_gather(mesh, lp)
+    loss, metrics = pipelined_loss(
+        mesh, lp, rest, {}, aux, {"inv_denom": inv_denom},
+        pp_chunks=model.pp_chunks, stage_fn=_stage_fn_for(model, gather, causal=True),
+        pre_fn=pre_fn, mask_fn=lambda ac: ac["pad"], head_fn=head_fn,
+        lp_specs=lp_specs)
+    return {"loss": loss, "nll": loss, "acc": metrics["acc"],
+            "ppl": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def diffuseq_1f1b_losses(model, schedule, params, batch,
+                         rng: jax.Array) -> Dict[str, jnp.ndarray]:
+    """DiffuSeq objective with the denoiser trunk on the 1F1B schedule.
+
+    Only the mse term runs through the blocks; tT and decoder_nll depend on
+    the word embedding alone and stay on ordinary AD (diffuseq.py
+    diffuseq_losses). x_t and x_start enter the engine as DIFFERENTIABLE
+    data (``diff``) so the word-embedding gradient through the noising and
+    the mse target is preserved."""
+    from .diffuseq import DiffuSeqModel, _masked_mean, timestep_embedding
+    from .pipeline import _layernorm
+    from ..ops.xent import token_cross_entropy
+
+    mesh = current_mesh()
+    _check_pipe_mesh(mesh)
+    ids = batch["input_ids"]
+    tgt_mask = batch["input_mask"].astype(jnp.float32)
+    pad_mask = batch["pad_mask"]
+    B, L = ids.shape
+
+    rng_t, rng_noise = jax.random.split(rng)
+    x_start = model.apply(params, ids, method=DiffuSeqModel.embed)
+    t = schedule.sample_t(rng_t, B)
+    noise = jax.random.normal(rng_noise, x_start.shape, x_start.dtype)
+    x_noisy = schedule.q_sample(x_start, t, noise)
+    x_t = jnp.where(tgt_mask[..., None] > 0, x_noisy, x_start)
+
+    p = params["params"]
+    lp = dict(p["backbone"]["blocks"])
+    rest = {"in_w": p["in_proj"]["kernel"], "in_b": p["in_proj"]["bias"],
+            "t0_w": p["time_mlp"]["layers_0"]["kernel"],
+            "t0_b": p["time_mlp"]["layers_0"]["bias"],
+            "t1_w": p["time_mlp"]["layers_2"]["kernel"],
+            "t1_b": p["time_mlp"]["layers_2"]["bias"],
+            "pos_emb": p["pos_emb"],
+            "ln_f_scale": p["backbone"]["ln_f"]["scale"],
+            "ln_f_bias": p["backbone"]["ln_f"]["bias"],
+            "out_w": p["out_proj"]["kernel"], "out_b": p["out_proj"]["bias"]}
+    inv_tgt = 1.0 / jnp.maximum(tgt_mask.sum(), 1.0)
+    dtype = model.dtype
+    H = model.hidden_size
+
+    def pre_fn(r, dc, ac, sc):
+        del sc
+        h = (jnp.einsum("ble,eh->blh", dc["x_t"].astype(dtype),
+                        r["in_w"].astype(dtype)) + r["in_b"].astype(dtype))
+        te = timestep_embedding(ac["t"], H)
+        te = jax.nn.silu(te @ r["t0_w"] + r["t0_b"]) @ r["t1_w"] + r["t1_b"]
+        h = h + te[:, None, :].astype(dtype)
+        return h + r["pos_emb"][None, :L].astype(dtype)
+
+    def head_fn(r, h, dc, ac, sc):
+        h = _layernorm(h, r["ln_f_scale"], r["ln_f_bias"]).astype(dtype)
+        x0_hat = (jnp.einsum("blh,he->ble", h, r["out_w"].astype(dtype))
+                  + r["out_b"].astype(dtype)).astype(jnp.float32)
+        per = jnp.mean((x0_hat - dc["x_start"]) ** 2, axis=-1)
+        loss_sum = (per * ac["tm"]).sum() * sc["inv_tgt"]
+        return loss_sum.astype(jnp.float32), {}
+
+    lp_specs, gather = _lp_specs_and_gather(mesh, lp)
+    mse, _ = pipelined_loss(
+        mesh, lp, rest, {"x_t": x_t, "x_start": x_start},
+        {"t": t, "pad": pad_mask, "tm": tgt_mask}, {"inv_tgt": inv_tgt},
+        pp_chunks=model.pp_chunks, stage_fn=_stage_fn_for(model, gather, causal=False),
+        pre_fn=pre_fn, mask_fn=lambda ac: ac["pad"], head_fn=head_fn,
+        lp_specs=lp_specs)
+
+    tT = _masked_mean(schedule.mean_flat_tT(x_start), tgt_mask)
+    logits = model.apply(params, x_start, method=DiffuSeqModel.logits)
+    decoder_nll = _masked_mean(token_cross_entropy(logits, ids), tgt_mask)
+    loss = mse + tT + decoder_nll
+    return {"loss": loss, "mse": mse, "tT": tT, "decoder_nll": decoder_nll}

@@ -41,11 +41,13 @@ def test_block_fwd_matches_flax_block():
                                rtol=2e-5, atol=2e-5)
 
 
-def stacked_workload(fam="gpt2", remat=False):
+def stacked_workload(fam="gpt2", remat=False, pp_schedule="1f1b",
+                     pp_chunks=4):
     return create_model_from_config(
         model_family=fam, vocab_size=64, seq_len=16, hidden_size=32,
         num_layers=4, num_heads=2, diffusion_steps=50, dtype="float32",
-        scan_layers=True, remat=remat)
+        scan_layers=True, remat=remat, pp_schedule=pp_schedule,
+        pp_chunks=pp_chunks)
 
 
 @pytest.mark.parametrize("fam", ["gpt2", "diffuseq"])
@@ -68,12 +70,16 @@ def test_scan_layers_trains(tmp_path, fam):
 
 
 @pytest.mark.parametrize("fam", ["gpt2", "diffuseq"])
-def test_gpipe_loss_invariant_vs_pure_dp(tmp_path, fam):
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_pipeline_loss_invariant_vs_pure_dp(tmp_path, fam, sched):
     """THE pipeline correctness test: identical stacked params + batch give
     identical losses on {dp:8} (sequential layer scan) and {dp:2, pipe:4}
-    (4-stage GPipe streaming) for TWO steps — step 2 equality covers the
-    backward/optimizer path through the schedule's ppermutes."""
-    wl = stacked_workload(fam)
+    (4-stage streaming) for TWO steps — step 2 equality covers the
+    backward/optimizer path. Parametrized over both training schedules:
+    gpipe (AD through the forward-only stream) and 1f1b (the streaming
+    custom_vjp in models/schedule_1f1b.py computing loss+grads in one
+    combined pass)."""
+    wl = stacked_workload(fam, pp_schedule=sched)
     name = "synthetic-lm" if fam == "gpt2" else "synthetic-seq2seq"
     batch = next(load_data_from_args("train", batch_size=8, dataset=name,
                                      seq_len=16, vocab_size=64, seed=2))
@@ -92,15 +98,47 @@ def test_gpipe_loss_invariant_vs_pure_dp(tmp_path, fam):
     assert losses["dp"][1] < losses["dp"][0]  # and it actually learns
 
 
-@pytest.mark.parametrize("remat", [False, True])
-def test_gpipe_loss_invariant_vs_pure_dp_with_fsdp(tmp_path, remat):
+def test_1f1b_stash_ring_smaller_than_chunks(tmp_path):
+    """The 1F1B memory claim, asserted: with M=8 chunks on S=4 stages the
+    input-stash ring holds only min(M, 2S-1)=7 chunks (< M — peak live
+    chunks do NOT scale with pp_chunks, unlike GPipe's AD residuals), and
+    the schedule still reproduces the pure-DP loss through the wraparound
+    of the ring."""
+    from distributed_pipeline_tpu.models.schedule_1f1b import stash_size
+
+    assert stash_size(8, 4) == 7 < 8
+    assert stash_size(4, 4) == 4      # capped at M
+    assert stash_size(64, 4) == 7     # constant in M
+    wl = stacked_workload("gpt2", pp_schedule="1f1b", pp_chunks=8)
+    batch = next(load_data_from_args("train", batch_size=16,
+                                     dataset="synthetic-lm", seq_len=16,
+                                     vocab_size=64, seed=4))
+    losses = {}
+    for tag, axes in (("dp", dict(dp=8)), ("pp", dict(dp=2, pipe=4))):
+        loop = TrainLoop(model=wl, data=iter([batch]), batch_size=16,
+                         lr=1e-3, ema_rate="0.9", learning_steps=10,
+                         log_interval=10 ** 6, save_interval=10 ** 9,
+                         mesh=make_mesh(**axes),
+                         checkpoint_dir=str(tmp_path / tag), seed=5)
+        l1 = float(loop.run_step(batch)["loss"])
+        l2 = float(loop.run_step(batch)["loss"])
+        losses[tag] = (l1, l2)
+    np.testing.assert_allclose(losses["dp"][0], losses["pp"][0], rtol=2e-5)
+    np.testing.assert_allclose(losses["dp"][1], losses["pp"][1], rtol=2e-5)
+
+
+@pytest.mark.parametrize("remat,sched", [(False, "gpipe"), (True, "gpipe"),
+                                         (False, "1f1b"), (True, "1f1b")])
+def test_pipeline_loss_invariant_vs_pure_dp_with_fsdp(tmp_path, remat,
+                                                      sched):
     """pipe x fsdp (ZeRO-3-inside-PP): identical params + batch give the
     same loss on {dp:8} as on {fsdp:2, pipe:4} — stage weights sharded over
     fsdp on the embed dim, gathered in-stage, grads reduce-scattered. Two
     steps deep so the backward/optimizer path is covered too. remat=True
     additionally covers the per-layer gather inside the checkpointed scan
-    body (weights rematerialized, not saved as residuals)."""
-    wl = stacked_workload("gpt2", remat=remat)
+    body (weights rematerialized, not saved as residuals); both training
+    schedules are exercised."""
+    wl = stacked_workload("gpt2", remat=remat, pp_schedule=sched)
     batch = next(load_data_from_args("train", batch_size=8,
                                      dataset="synthetic-lm", seq_len=16,
                                      vocab_size=64, seed=3))
