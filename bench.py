@@ -45,7 +45,9 @@ def main() -> None:
 
     def measure(name: str, *, family: str, size: str, seq_len: int,
                 batch, microbatch: int = 0, remat: bool = False,
-                vocab: int = 8192, attention_impl: str = "auto"):
+                vocab: int = 8192, attention_impl: str = "auto",
+                moe_experts: int = 0, moe_top_k: int = 2,
+                scan_layers: bool = False):
         """tokens/sec for one config; warmup step compiles, then a timed
         window. ``batch`` is PER HOST (reference trainer.py:89 semantics:
         global = batch x hosts); a tuple tries sizes left-to-right and falls
@@ -57,7 +59,10 @@ def main() -> None:
                     return measure(name, family=family, size=size,
                                    seq_len=seq_len, batch=b,
                                    microbatch=microbatch, remat=remat,
-                                   vocab=vocab, attention_impl=attention_impl)
+                                   vocab=vocab, attention_impl=attention_impl,
+                                   moe_experts=moe_experts,
+                                   moe_top_k=moe_top_k,
+                                   scan_layers=scan_layers)
                 except Exception as e:
                     if i == len(batch) - 1:
                         raise
@@ -73,7 +78,9 @@ def main() -> None:
             hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
         wl = create_model_from_config(
             model_family=family, model_size=size, seq_len=seq_len,
-            dtype=dtype, remat=remat, attention_impl=attention_impl, **dims)
+            dtype=dtype, remat=remat, attention_impl=attention_impl,
+            moe_experts=moe_experts, moe_top_k=moe_top_k,
+            scan_layers=scan_layers, **dims)
         dataset = "synthetic-lm" if family == "gpt2" else "synthetic-seq2seq"
         data = load_data_from_args("train", batch_size=batch, dataset=dataset,
                                    seq_len=seq_len,
@@ -96,8 +103,26 @@ def main() -> None:
         jax.block_until_ready(m["loss"])
         dt = time.perf_counter() - t0
         tps = steps * batch * seq_len * jax.process_count() / dt
+        # MFU against ACTIVE params: a top-k routed MoE block only runs
+        # top_k of its moe_experts expert MLPs per token, so counting every
+        # expert's weights would overstate the model flops. Inactive mass
+        # is derived from the actual expert weight shapes (leading dim ==
+        # moe_experts under a "moe" module) so it tracks models/moe.py by
+        # construction.
+        n_active = loop.n_params
+        if moe_experts > moe_top_k:
+            import numpy as np
+            from jax.tree_util import tree_flatten_with_path
+            leaves, _ = tree_flatten_with_path(loop.state.params)
+            expert_params = sum(
+                int(np.prod(leaf.shape))
+                for path, leaf in leaves
+                if any("moe" in str(getattr(k, "key", k)) for k in path)
+                and leaf.ndim >= 2 and leaf.shape[0] == moe_experts)
+            n_active -= round(expert_params
+                              * (moe_experts - moe_top_k) / moe_experts)
         fpt = transformer_train_flops_per_token(
-            loop.n_params, wl.num_layers, wl.hidden_size, seq_len)
+            n_active, wl.num_layers, wl.hidden_size, seq_len)
         return {
             "name": name,
             "tokens_per_sec_per_chip": round(tps / jax.device_count(), 1),
@@ -105,6 +130,48 @@ def main() -> None:
             "n_params": loop.n_params,
             "batch": batch, "microbatch": microbatch or batch,
             "seq_len": seq_len, "remat": remat,
+        }
+
+    def measure_decode(name: str, *, gen_tokens: int, batch: int,
+                       seq_len: int, vocab: int = 8192):
+        """KV-cache generation throughput (tokens/sec DECODED, not
+        trained): gpt2-base greedy-continues a batch of prompts by
+        ``gen_tokens`` single-position cached steps (models/sampling.py
+        gpt2_decode prefill + per-token path). Decode is latency-bound —
+        each step is one [B, 1, D] forward against the cache — so the
+        right scale is tokens/s, not MFU."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distributed_pipeline_tpu.models.sampling import gpt2_decode
+
+        dims = dict(vocab_size=vocab) if on_tpu else dict(
+            hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
+        wl = create_model_from_config(
+            model_family="gpt2", model_size="base", seq_len=seq_len,
+            dtype=dtype, **dims)
+        params = wl.init_params(jax.random.PRNGKey(0))
+        prompt_len = seq_len - gen_tokens
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(4, dims["vocab_size"],
+                                              (batch, seq_len), np.int32))
+        run = jax.jit(lambda p, i: gpt2_decode(wl, p, i, prompt_len))
+        out = jax.block_until_ready(run(params, ids))  # compile
+        reps = 3 if on_tpu else 1
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run(params, ids)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        # plain jit, no mesh: the decode runs on ONE device, so tps IS the
+        # per-chip number — dividing by device_count would understate it
+        # on multi-chip hosts
+        tps = reps * batch * gen_tokens / dt
+        return {
+            "name": name,
+            "decode_tokens_per_sec_per_chip": round(tps, 1),
+            "batch": batch, "gen_tokens": gen_tokens, "seq_len": seq_len,
+            "prompt_len": prompt_len,
         }
 
     # Per-chip batch sizes are the measured MFU sweet spots on v5e (base:
@@ -146,9 +213,33 @@ def main() -> None:
         # (measured 1.67x the XLA path at this shape on v5e). The CPU
         # smoke run shrinks the sequence: a 4k dense attention on one CPU
         # core takes minutes and measures nothing.
+        # batch/microbatch are the r4 sweep optimum (saturates from b=32;
+        # microbatch 2 beats 1 and 4 at both lengths); 1024x1024 kernel
+        # blocks + the diagonal-only causal masking lifted this shape
+        # 41.5% -> 49.6% MFU (PARITY.md long-context section).
         measure("gpt2-base-seq4096-flash", family="gpt2", size="base",
                 seq_len=4096 if on_tpu else 256,
-                batch=(bsz(16), bsz(8), bsz(4)), microbatch=bsz(2)),
+                batch=(bsz(64), bsz(16), bsz(4)), microbatch=bsz(2)),
+        # Long-context curve extension: 8k context through the same flash
+        # path (quadratic attention share doubles vs 4k).
+        measure("gpt2-base-seq8192-flash", family="gpt2", size="base",
+                seq_len=8192 if on_tpu else 256,
+                batch=(bsz(32), bsz(8), bsz(2)), microbatch=bsz(2)),
+        # MoE: 8 experts top-2 in every 2nd block — measures the one-hot
+        # dispatch/combine einsum cost on real hardware (MFU against
+        # ACTIVE params: only top_k experts run per token).
+        measure("diffuseq-base-seq128-moe8", family="diffuseq", size="base",
+                seq_len=128, batch=(bsz(256), bsz(64)),
+                microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2),
+        # scan_layers: the stacked-weights layer scan (one traced block) —
+        # quantifies the compile-time-vs-MFU tradeoff PARITY.md documents,
+        # in the driver signal.
+        measure("diffuseq-base-seq128-scan", family="diffuseq", size="base",
+                seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1,
+                scan_layers=True),
+        # KV-cache decode throughput (generation, not training).
+        measure_decode("gpt2-base-decode128", gen_tokens=128 if on_tpu else 8,
+                       batch=bsz(64), seq_len=1024 if on_tpu else 64),
     ]
 
     head = configs[0]

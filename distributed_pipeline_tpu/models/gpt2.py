@@ -55,9 +55,12 @@ class GPT2Model(nn.Module):
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ("vocab", EMBED)),
             param_dtype=jnp.float32, name="word_emb")
+        # pos_emb stays replicated: like the table's hidden dim, sharding
+        # it over fsdp would push fsdp onto h's hidden dim (it adds
+        # directly into the activation) and fight the batch sharding
         pos_emb = self.param(
             "pos_emb", nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), (None, EMBED)),
+                nn.initializers.normal(0.02), (None, None)),
             (self.seq_len, self.hidden_size), jnp.float32)
         if cache_index is not None and L == 1:
             pos = jax.lax.dynamic_slice(

@@ -96,8 +96,24 @@ def _build() -> bool:
             finally:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
+        if os.path.exists(_SO):
+            # Sources are newer but no compiler produced a fresh build:
+            # a previously working (stale) library beats the ~15x slower
+            # Python fallback. Warn so the developer knows edits to the
+            # .cpp are not live.
+            import warnings
+            warnings.warn(
+                "distributed_pipeline_tpu.native: recompile failed; using the "
+                "STALE prebuilt library (sources are newer than the .so)")
+            return True
         return False
     except OSError:
+        if os.path.exists(_SO):
+            import warnings
+            warnings.warn(
+                "distributed_pipeline_tpu.native: staleness check failed; "
+                "using the existing prebuilt library as-is")
+            return True
         return False
 
 
@@ -116,35 +132,43 @@ def load_library() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
+            _wire_symbols(lib)
+        # AttributeError: a stale .so accepted by _build() may predate a
+        # symbol added to the wiring below — degrade to Python, don't crash
+        except (OSError, AttributeError):
             _lib_failed = True
             return None
-        lib.dpt_bpe_create.restype = ctypes.c_void_p
-        lib.dpt_bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
-        lib.dpt_bpe_destroy.restype = None
-        lib.dpt_bpe_destroy.argtypes = [ctypes.c_void_p]
-        lib.dpt_bpe_encode.restype = ctypes.c_int64
-        lib.dpt_bpe_encode.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
-        lib.dpt_bpe_oov_count.restype = ctypes.c_int64
-        lib.dpt_bpe_oov_count.argtypes = [ctypes.c_void_p]
-        lib.dpt_bpe_oov_get.restype = ctypes.c_int64
-        lib.dpt_bpe_oov_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
-        lib.dpt_jsonl_open.restype = ctypes.c_void_p
-        lib.dpt_jsonl_open.argtypes = [ctypes.c_char_p]
-        lib.dpt_jsonl_count.restype = ctypes.c_int64
-        lib.dpt_jsonl_count.argtypes = [ctypes.c_void_p]
-        lib.dpt_jsonl_get.restype = ctypes.c_int64
-        lib.dpt_jsonl_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
-        lib.dpt_jsonl_close.restype = None
-        lib.dpt_jsonl_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+def _wire_symbols(lib: ctypes.CDLL) -> None:
+    """Declare every exported symbol's ctypes signature (raises
+    AttributeError if the library predates a symbol)."""
+    lib.dpt_bpe_create.restype = ctypes.c_void_p
+    lib.dpt_bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.dpt_bpe_destroy.restype = None
+    lib.dpt_bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.dpt_bpe_encode.restype = ctypes.c_int64
+    lib.dpt_bpe_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    lib.dpt_bpe_oov_count.restype = ctypes.c_int64
+    lib.dpt_bpe_oov_count.argtypes = [ctypes.c_void_p]
+    lib.dpt_bpe_oov_get.restype = ctypes.c_int64
+    lib.dpt_bpe_oov_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    lib.dpt_jsonl_open.restype = ctypes.c_void_p
+    lib.dpt_jsonl_open.argtypes = [ctypes.c_char_p]
+    lib.dpt_jsonl_count.restype = ctypes.c_int64
+    lib.dpt_jsonl_count.argtypes = [ctypes.c_void_p]
+    lib.dpt_jsonl_get.restype = ctypes.c_int64
+    lib.dpt_jsonl_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    lib.dpt_jsonl_close.restype = None
+    lib.dpt_jsonl_close.argtypes = [ctypes.c_void_p]
 
 
 def _pack_tables(merges: List[List[str]], vocab: Dict[str, int]) -> bytes:

@@ -228,9 +228,21 @@ int64_t dpt_bpe_encode(void* h, const uint8_t* text, uint64_t text_len,
   ids.reserve(text_len / 2 + 8);
   const char* s = reinterpret_cast<const char*>(text);
   size_t start = 0;
+  size_t words = 0;
   for (size_t i = 0; i <= text_len; ++i) {
     if (i == text_len || s[i] == '\n') {
-      if (i > start) EncodeWord(enc, s + start, i - start, &ids);
+      if (i > start) {
+        EncodeWord(enc, s + start, i - start, &ids);
+        // Re-check the memo cap inside huge single-call texts too. Only
+        // word_cache may flush mid-call: OOV sentinels already emitted
+        // into `ids` THIS call reference oov_symbols, so those tables
+        // flush only between calls (entry check above) — one call's OOV
+        // growth is bounded by its distinct unknown words.
+        if ((++words & 0xfff) == 0 &&
+            enc->word_cache.size() > kWordCacheCap) {
+          enc->word_cache.clear();
+        }
+      }
       start = i + 1;
     }
   }

@@ -16,6 +16,13 @@
 // applies. A corpus must index identically whether or not the native
 // build succeeded.
 //
+// IMMUTABLE-CORPUS ASSUMPTION: the offset table is built once at open and
+// the mapping is never revalidated. If the file is truncated or rewritten
+// while a training run holds it open, later dpt_jsonl_get reads can touch
+// unmapped pages and SIGBUS the process (the Python fallback, which copies
+// lines at open, would not). Treat training corpora as append-never,
+// replace-by-rename artifacts — the standard contract for mmap'd data.
+//
 // C ABI (ctypes, native/__init__.py):
 //   dpt_jsonl_open(path)          -> handle | nullptr (open/mmap error)
 //   dpt_jsonl_count(h)            -> number of non-blank lines

@@ -113,7 +113,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    causal: bool = False,
                    axis_name: str = "sequence",
                    use_flash: bool = True,
-                   block_q: int = 512, block_k: int = 512) -> jnp.ndarray:
+                   block_q: int = 1024, block_k: int = 1024) -> jnp.ndarray:
     """Exact attention over sequence-sharded [B, H, L_local, Dh] inputs.
     Must run inside ``shard_map`` with ``axis_name`` bound.
 

@@ -28,10 +28,17 @@ __all__ = ["LOGICAL_RULES", "param_shardings", "batch_shardings",
 #                     weight has an "embed" dim, so every weight shards.
 #   mlp/heads -> tensor: Megatron-style TP pairing — wi column-, wo
 #                     row-parallel; attention heads split across chips.
-#   vocab  -> tensor: embedding/logit matrix splits over vocab.
+#   vocab  -> tensor+fsdp: embedding/logit matrix splits over vocab.
 LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("batch", ("data", "fsdp", "expert")),
-    ("vocab", "tensor"),
+    # vocab shards over tensor (Megatron vocab-parallel logits) AND fsdp
+    # (ZeRO for the big tied table — on its VOCAB dim, not hidden: a
+    # hidden-sharded table propagates fsdp onto every [B, L, hidden]
+    # activation it produces, which fights the batch sharding and forces
+    # the SPMD partitioner into full-replication resharding. Falls back
+    # to replication when the vocab doesn't divide; pad the vocab to keep
+    # ZeRO coverage).
+    ("vocab", ("tensor", "fsdp")),
     ("embed", "fsdp"),
     ("mlp", "tensor"),
     ("heads", "tensor"),

@@ -85,6 +85,14 @@ def main(namespace: argparse.Namespace) -> None:
     if resume_step and rank == 0:
         logger.info(f"fast-forwarding data stream past {resume_step} "
                     f"consumed batches (exact-order resume)")
+        # The eval stream's fast-forward divides by eval_interval; the
+        # interval is not recorded in the checkpoint (filenames carry the
+        # step only), so a changed flag silently replays/skips eval
+        # batches while the TRAIN stream stays exact.
+        logger.warn(f"eval-stream fast-forward assumes --eval_interval "
+                    f"({args.eval_interval}) is unchanged from the "
+                    f"original run; eval batches replay or skip if it "
+                    f"differed (train stream is exact either way)")
     data = load_data_from_args("train", skip_batches=resume_step,
                                **args.dict())
     eval_data = load_data_from_args(

@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 from etils import epath
 
+from . import logger
+
 import orbax.checkpoint as ocp
 
 __all__ = [
@@ -162,11 +164,22 @@ def prune_checkpoints(directory: str, keep: int) -> List[int]:
     doomed = set(steps[:-keep] if len(steps) > keep else [])
     if not doomed:
         return []
+    pruned = set()
     for child, name in children:
         if (name.startswith(("model_", "ema_", "opt_"))
                 and parse_step_from_name(name) in doomed):
-            child.rmtree()
-    return sorted(doomed)
+            try:
+                child.rmtree()
+                pruned.add(parse_step_from_name(name))
+            # broad by design: epath's gs:// backends surface failures as
+            # tf.errors.OpError / gcsfs HttpError etc., not OSError
+            except Exception as e:
+                # Retention is housekeeping: a delete failure (gs://
+                # permissions, concurrent cleanup) must never abort the
+                # training run that just saved successfully.
+                logger.warn(f"checkpoint retention: could not delete "
+                            f"{child}: {e}")
+    return sorted(pruned)
 
 
 def restore_checkpoint(path: str, abstract_target: Any) -> Any:
