@@ -31,8 +31,8 @@ from . import logger
 import orbax.checkpoint as ocp
 
 __all__ = [
-    "save_checkpoint", "restore_checkpoint", "restore_resume_state",
-    "resume_target",
+    "save_checkpoint", "AsyncSaver", "restore_checkpoint",
+    "restore_resume_state", "resume_target",
     "parse_step_from_name", "find_resume_checkpoint", "find_ema_checkpoint",
     "find_opt_checkpoint", "latest_step", "prune_checkpoints",
 ]
@@ -118,26 +118,73 @@ def latest_step(directory: str) -> int:
     return found[-1][0] if found else 0
 
 
+class AsyncSaver:
+    """At most ONE checkpoint save in flight, overlapping training.
+
+    Orbax's ``StandardCheckpointer.save`` is async: the device-to-host
+    fetch happens synchronously inside ``save()`` (so the caller may
+    freely donate/overwrite device buffers afterwards — the jitted step's
+    ``donate_argnums`` is safe), while the durable write proceeds on
+    background threads. The reference blocks the step loop for the whole
+    write (its save + barrier, trainer.py:277-302); here the barrier
+    moves to where it is actually needed: before the NEXT save, before
+    retention pruning, and at exit (``wait()``). At BASELINE-5 scale
+    params + 3 EMA copies + Adam state is ~5x model size — that write now
+    costs the step loop only the D2H fetch."""
+
+    def __init__(self) -> None:
+        self._ckptrs: List[ocp.Checkpointer] = []
+
+    def wait(self) -> None:
+        """Block until every in-flight save is durable."""
+        for c in self._ckptrs:
+            c.wait_until_finished()
+            c.close()
+        self._ckptrs = []
+
+    def save(self, directory: str, step: int, params: Any,
+             ema: Optional[Dict[str, Any]] = None,
+             opt_state: Optional[Any] = None, wait: bool = False) -> None:
+        """Schedule ``model_{step:06d}`` (+ ``ema_{rate}_``/``opt_``)
+        under ``directory``. Multi-host safe: every process must call this
+        (Orbax coordinates the single-writer protocol). Waits for the
+        PREVIOUS save first (one step's saves in flight max — the
+        reference's barrier-before-next-save contract); ``wait=True`` also
+        blocks until THIS save is durable (the reference's
+        fully-synchronous semantics).
+
+        One checkpointer PER TREE: orbax's ``AsyncCheckpointer.save``
+        waits for that handle's previous write on entry, so scheduling
+        model + EMAs + opt on a single handle would serialize them and
+        only overlap the last — separate handles let all trees' writes
+        proceed concurrently in the background."""
+        self.wait()
+        d = epath.Path(directory)
+        if not d.is_absolute() and "://" not in directory:
+            d = epath.Path(os.path.abspath(directory))  # orbax: absolute
+        if jax.process_index() == 0:
+            d.mkdir(parents=True, exist_ok=True)
+        trees = [(d / f"model_{step:06d}", params)]
+        trees += [(d / f"ema_{rate}_{step:06d}", tree)
+                  for rate, tree in (ema or {}).items()]
+        if opt_state is not None:
+            trees.append((d / f"opt_{step:06d}", opt_state))
+        for path, tree in trees:
+            ckptr = _checkpointer()
+            ckptr.save(path, tree, force=True)
+            self._ckptrs.append(ckptr)
+        if wait:
+            self.wait()
+
+
 def save_checkpoint(directory: str, step: int, params: Any,
                     ema: Optional[Dict[str, Any]] = None,
                     opt_state: Optional[Any] = None) -> None:
-    """Write ``model_{step:06d}`` (+ ``ema_{rate}_``/``opt_``) under
-    ``directory``. Multi-host safe: every process must call this (Orbax
-    coordinates the single-writer protocol); all processes block until the
-    write is durable (the reference barriers after save, trainer.py:282)."""
-    d = epath.Path(directory)
-    if not d.is_absolute() and "://" not in directory:
-        d = epath.Path(os.path.abspath(directory))  # orbax requires absolute
-    if jax.process_index() == 0:
-        d.mkdir(parents=True, exist_ok=True)
-    ckptr = _checkpointer()
-    ckptr.save(d / f"model_{step:06d}", params, force=True)
-    for rate, tree in (ema or {}).items():
-        ckptr.save(d / f"ema_{rate}_{step:06d}", tree, force=True)
-    if opt_state is not None:
-        ckptr.save(d / f"opt_{step:06d}", opt_state, force=True)
-    ckptr.wait_until_finished()
-    ckptr.close()
+    """Synchronous one-shot save: all processes block until the write is
+    durable (the reference's semantics, trainer.py:282). The step loop
+    uses :class:`AsyncSaver` instead to overlap the write with training."""
+    AsyncSaver().save(directory, step, params, ema=ema,
+                      opt_state=opt_state, wait=True)
 
 
 def prune_checkpoints(directory: str, keep: int) -> List[int]:

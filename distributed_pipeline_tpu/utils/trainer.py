@@ -134,6 +134,7 @@ class TrainLoop:
         self.learning_steps = learning_steps
         self.warmup_steps = warmup_steps
         self.keep_checkpoints = keep_checkpoints
+        self._saver = ckpt_lib.AsyncSaver()
         self.checkpoint_dir = checkpoint_dir or logger.get_dir() or ""
         # SURVEY.md §5.1 rebuild note: a first-class jax.profiler trace hook.
         # A short window a few steps in (past compilation) is captured into
@@ -436,32 +437,59 @@ class TrainLoop:
                     for cb in self.eval_callbacks:
                         cb(self)
                 if self.step % self.save_interval == 0:
-                    self.save()
+                    self.save(wait=False)  # write overlaps training
         finally:
             if self._profiling:  # run ended (or raised) inside the window:
                 jax.profiler.stop_trace()  # flush the trace either way
                 self._profiling = False
+            # exception path too: drain the in-flight save before
+            # unwinding — a process exiting mid-commit can hang the other
+            # hosts in orbax's finalization barrier
+            self.wait_for_saves()
         if self.step % self.save_interval != 0:
-            self.save()
+            self.save(wait=False)
+        self.wait_for_saves()  # exit barrier: the last write must be durable
+        self._prune()  # final retention pass over the finalized set
 
     __call__ = run_loop  # reference trainer.py:357
 
     # ------------------------------------------------------------ checkpoint
 
-    def save(self) -> None:
+    def save(self, wait: bool = True) -> None:
         """model_/ema_{rate}_/opt_{step:06d} under the run dir (reference
-        save(), trainer.py:277-302)."""
+        save(), trainer.py:277-302). ``wait=False`` (what the step loop
+        passes) schedules the write ASYNC so it overlaps the next
+        ``save_interval`` of training; the barrier then runs before the
+        next save, before retention pruning, and at loop exit
+        (checkpoint.AsyncSaver). Orbax fetches to host synchronously inside
+        the call, so the jitted step's buffer donation stays safe. The
+        default ``wait=True`` keeps direct calls durable-on-return."""
         if not self.checkpoint_dir:
             logger.warn("no checkpoint_dir configured; skipping save")
             return
-        ckpt_lib.save_checkpoint(
+        self._saver.save(
             self.checkpoint_dir, self.step, self.state.params,
             ema={r: self.state.ema[r] for r in self.ema_rates},
-            opt_state=self.state.opt_state)
-        logger.info(f"saved checkpoint at step {self.step} "
-                    f"-> {self.checkpoint_dir}")
+            opt_state=self.state.opt_state, wait=wait)
+        mode = ("saved checkpoint" if wait
+                else "scheduled async checkpoint save")
+        logger.info(f"{mode} at step {self.step} -> {self.checkpoint_dir}")
+        # Retention ranks only FINALIZED checkpoints (unfinalized orbax tmp
+        # dirs are excluded by prune_checkpoints), so pruning here never
+        # needs to barrier on the save just scheduled: with wait=False it
+        # simply lags by the one in-flight save (bounded at keep+1 dirs on
+        # disk; run_loop runs a final pass at exit).
+        self._prune()
+
+    def _prune(self) -> None:
+        if self.keep_checkpoints <= 0:
+            return
         pruned = ckpt_lib.prune_checkpoints(self.checkpoint_dir,
                                             self.keep_checkpoints)
         if pruned:
             logger.info(f"pruned checkpoints at steps {pruned} "
                         f"(keep_checkpoints={self.keep_checkpoints})")
+
+    def wait_for_saves(self) -> None:
+        """Barrier on the in-flight async checkpoint saves, if any."""
+        self._saver.wait()

@@ -348,6 +348,37 @@ def test_lr_warmup_schedule(tmp_path):
     assert np.isclose(float(m["lr"]), 1e-3 * (1 / 10) * 1.0, rtol=1e-3)
 
 
+def test_async_save_overlaps_training(tmp_path):
+    """save(wait=False) — the run_loop path — schedules the write and
+    returns; training steps proceed while it is in flight, and the bytes
+    that land are the state AT SAVE TIME, not the mutated-by-later-steps
+    state (Orbax's synchronous device-to-host fetch is what makes the
+    jitted step's buffer donation safe)."""
+    loop = make_loop(tmp_path, save_interval=10 ** 9)
+    for _ in range(2):
+        loop.run_step(next(loop.data))
+    snapshot = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
+                                      loop.state.params)
+    loop.save(wait=False)
+    for _ in range(3):  # training proceeds; params diverge from snapshot
+        m = loop.run_step(next(loop.data))
+    assert np.isfinite(float(m["loss"]))
+    loop.wait_for_saves()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), snapshot)
+    restored = ckpt.restore_checkpoint(
+        os.path.join(str(tmp_path), "model_000002"), abstract)
+    for a, b in zip(jax.tree_util.tree_leaves(snapshot),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the post-save steps really moved the live params
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(snapshot),
+                        jax.tree_util.tree_leaves(loop.state.params)))
+    assert moved
+
+
 def test_keep_checkpoints_prunes_old_steps(tmp_path):
     """--keep_checkpoints N retains only the newest N steps, pruning
     model+EMA+opt together; 0 keeps everything (reference behavior)."""
