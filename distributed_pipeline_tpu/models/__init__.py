@@ -125,15 +125,16 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                          f"available: {sorted(PRESETS)}")
     if moe_experts > 0 and moe_every < 1:
         raise ValueError(f"moe_every must be >= 1, got {moe_every}")
-    if scan_layers and moe_experts > 0:
-        raise ValueError("scan_layers (stacked/pipelined blocks) does not "
-                         "yet compose with MoE; use one or the other")
     preset = PRESETS[model_family].get(model_size)
     if preset is None:
         raise ValueError(f"no preset {model_size!r} for family {model_family!r}; "
                          f"available: {sorted(PRESETS[model_family])}")
     hidden = hidden_size or preset[0]
     layers = num_layers or preset[1]
+    if scan_layers and moe_experts > 0 and layers % moe_every:
+        raise ValueError(
+            f"scan_layers MoE scans uniform groups of moe_every blocks: "
+            f"num_layers {layers} must divide by moe_every {moe_every}")
     heads = num_heads or preset[2]
     jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 

@@ -194,14 +194,25 @@ class TransformerBackbone(nn.Module):
                  pad_mask: Optional[jnp.ndarray] = None,
                  cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         if self.scan_layers:
-            from .pipeline import PipelinedBlocks
-            x = PipelinedBlocks(
-                self.num_layers, self.num_heads, x.shape[-1],
-                dtype=self.dtype, causal=self.causal, remat=self.remat,
-                pp_chunks=self.pp_chunks,
-                attention_impl=self.attention_impl,
-                decode=self.decode,
-                name="blocks")(x, pad_mask, cache_index)
+            if self.moe_experts > 0:
+                from .pipeline import MoEScanBlocks
+                x = MoEScanBlocks(
+                    self.num_layers, self.num_heads, x.shape[-1],
+                    dtype=self.dtype, causal=self.causal,
+                    moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
+                    moe_every=self.moe_every,
+                    moe_no_drop=self.moe_no_drop, remat=self.remat,
+                    attention_impl=self.attention_impl,
+                    name="blocks")(x, pad_mask, cache_index)
+            else:
+                from .pipeline import PipelinedBlocks
+                x = PipelinedBlocks(
+                    self.num_layers, self.num_heads, x.shape[-1],
+                    dtype=self.dtype, causal=self.causal, remat=self.remat,
+                    pp_chunks=self.pp_chunks,
+                    attention_impl=self.attention_impl,
+                    decode=self.decode,
+                    name="blocks")(x, pad_mask, cache_index)
             return nn.LayerNorm(dtype=jnp.float32,
                                 name="ln_f")(x).astype(self.dtype)
         block_cls = Block

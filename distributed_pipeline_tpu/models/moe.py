@@ -36,7 +36,8 @@ EXPERT = "expert"
 
 MOE_AUX_WEIGHT = 0.01  # Switch Transformer's load-balance loss coefficient
 
-__all__ = ["MoEMlp", "EXPERT", "MOE_AUX_WEIGHT", "moe_aux_from"]
+__all__ = ["MoEMlp", "moe_mlp_fwd", "EXPERT", "MOE_AUX_WEIGHT",
+           "moe_aux_from"]
 
 
 def moe_aux_from(variables: Dict) -> jnp.ndarray:
@@ -74,9 +75,8 @@ class MoEMlp(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-        B, L, D = x.shape
-        E, K = self.num_experts, min(self.top_k, self.num_experts)
-        C = max(1, math.ceil(L / E * self.capacity_factor * K))
+        D = x.shape[-1]
+        E = self.num_experts
 
         router_w = self.param(
             "router", nn.with_logical_partitioning(
@@ -91,80 +91,104 @@ class MoEMlp(nn.Module):
                 _dense_init(self.expand * D), (EXPERT, MLP, EMBED)),
             (E, self.expand * D, D), jnp.float32)
 
-        # Router in f32 (tiny op; softmax statistics want the precision).
-        logits = jnp.einsum("bld,de->ble", x.astype(jnp.float32), router_w)
-        probs = jax.nn.softmax(logits, axis=-1)              # [B, L, E]
-
-        # Pad tokens must neither claim expert capacity nor steer the
-        # load-balance statistics (seq2seq batches pad heavily; all pads
-        # share one embedding and would pile onto one expert).
-        live = (jnp.ones((B, L), jnp.float32) if pad_mask is None
-                else pad_mask.astype(jnp.float32))
-
-        # Iterative top-k: pick, mask out, repeat (K is tiny and static).
-        remaining = probs
-        gates, masks = [], []
-        for _ in range(K):
-            idx = jnp.argmax(remaining, axis=-1)             # [B, L]
-            mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B, L, E]
-            remaining = remaining * (1.0 - mask)
-            mask = mask * live[..., None]  # pads claim nothing
-            gates.append((probs * mask).sum(-1))             # [B, L]
-            masks.append(mask)
-
-        # Switch load-balancing loss: E * sum_e (token fraction to e) *
-        # (mean router prob of e), over the k=0 assignment — masked means
-        # over REAL tokens only.
-        n_live = jnp.maximum(live.sum(), 1.0)
-        f = masks[0].sum(axis=(0, 1)) / n_live               # [E]
-        p = (probs * live[..., None]).sum(axis=(0, 1)) / n_live
-        aux = E * jnp.sum(f * p)
+        y, aux, dispatch = moe_mlp_fwd(
+            {"router": router_w, "wi": wi, "wo": wo}, x, pad_mask,
+            top_k=self.top_k, capacity_factor=self.capacity_factor,
+            dtype=self.dtype, no_drop=self.no_drop)
         self.sow("losses", "moe_aux", aux,
                  init_fn=lambda: jnp.zeros(()), reduce_fn=jnp.add)
+        if dispatch is not None:
+            # Observable for tests (materializes only under mutable=
+            # ["intermediates"]): the [B, L, E, C] one-hot routing plan.
+            self.sow("intermediates", "dispatch", dispatch)
+        return y
 
-        if self.no_drop:
-            # Exact per-token mixture: every expert computed for every
-            # token, combined by normalized top-k gates. E x the MLP FLOPs,
-            # used on (cheap) inference paths only.
-            gate_mat = sum(g[..., None] * m for g, m in zip(gates, masks))
-            denom_all = jnp.maximum(sum(gates), 1e-9)        # [B, L]
-            w = gate_mat / denom_all[..., None]              # [B, L, E]
-            h = jnp.einsum("bld,edm->belm", x.astype(self.dtype),
-                           wi.astype(self.dtype))
-            h = nn.gelu(h, approximate=True)
-            out = jnp.einsum("belm,emd->beld", h, wo.astype(self.dtype))
-            y = jnp.einsum("ble,beld->bld", w.astype(self.dtype), out)
-            return y.astype(x.dtype)
 
-        # Capacity: interleave the K claim streams in (position, k) order —
-        # [B, L, K, E] -> [B, L*K, E] position-major — so slot occupancy at
-        # position j counts ONLY claims from positions <= j (causality).
-        claims = jnp.stack(masks, axis=2).reshape(B, L * K, E)
-        pos = jnp.cumsum(claims, axis=1) - claims            # [B, L*K, E]
-        keep_flat = claims * (pos < C)
-        slot_idx = (pos * keep_flat).sum(-1).astype(jnp.int32)
-        slot_flat = jax.nn.one_hot(slot_idx, C, dtype=jnp.float32)
-        keep = keep_flat.reshape(B, L, K, E)
-        slot = slot_flat.reshape(B, L, K, C)
+def moe_mlp_fwd(mp: Dict[str, jnp.ndarray], x: jnp.ndarray,
+                pad_mask: Optional[jnp.ndarray], *, top_k: int,
+                capacity_factor: float, dtype: jnp.dtype,
+                no_drop: bool = False):
+    """The MoE MLP as a pure function of its param dict ``{"router":
+    [D, E] f32, "wi": [E, D, M], "wo": [E, M, D]}`` — the single
+    implementation behind :class:`MoEMlp` (named blocks) AND the stacked
+    scan-layers path (pipeline.MoEScanBlocks), which slices per-group
+    weights out of a leading layers axis. Returns ``(y, aux_loss,
+    dispatch-or-None)``; the caller owns sowing."""
+    B, L, D = x.shape
+    E = mp["wi"].shape[0]
+    K = min(top_k, E)
+    C = max(1, math.ceil(L / E * capacity_factor * K))
+    router_w, wi, wo = mp["router"], mp["wi"], mp["wo"]
 
-        # Normalize kept gates so the combine weights sum to <= 1.
-        kept_gate = [g * keep[:, :, k].sum(-1) for k, g in enumerate(gates)]
-        denom = jnp.maximum(sum(kept_gate), 1e-9)
-        combine = jnp.zeros((B, L, E, C), jnp.float32)
-        for k, g in enumerate(gates):
-            w = (g / denom)[..., None] * keep[:, :, k]       # [B, L, E]
-            combine = combine + w[..., None] * slot[:, :, k][:, :, None, :]
-        dispatch = (combine > 0).astype(x.dtype)
-        # Observable for tests (materializes only under mutable=
-        # ["intermediates"]): the [B, L, E, C] one-hot routing plan.
-        self.sow("intermediates", "dispatch", dispatch)
+    # Router in f32 (tiny op; softmax statistics want the precision).
+    logits = jnp.einsum("bld,de->ble", x.astype(jnp.float32), router_w)
+    probs = jax.nn.softmax(logits, axis=-1)              # [B, L, E]
 
-        # Dispatch -> expert MLPs -> combine. The expert (e) dim of wi/wo is
-        # sharded over the mesh's expert axis; ein-summing it against
-        # batch-sharded activations is what makes XLA emit the all-to-alls.
-        xin = jnp.einsum("blec,bld->ebcd", dispatch, x.astype(self.dtype))
-        h = jnp.einsum("ebcd,edm->ebcm", xin, wi.astype(self.dtype))
+    # Pad tokens must neither claim expert capacity nor steer the
+    # load-balance statistics (seq2seq batches pad heavily; all pads
+    # share one embedding and would pile onto one expert).
+    live = (jnp.ones((B, L), jnp.float32) if pad_mask is None
+            else pad_mask.astype(jnp.float32))
+
+    # Iterative top-k: pick, mask out, repeat (K is tiny and static).
+    remaining = probs
+    gates, masks = [], []
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)             # [B, L]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B, L, E]
+        remaining = remaining * (1.0 - mask)
+        mask = mask * live[..., None]  # pads claim nothing
+        gates.append((probs * mask).sum(-1))             # [B, L]
+        masks.append(mask)
+
+    # Switch load-balancing loss: E * sum_e (token fraction to e) *
+    # (mean router prob of e), over the k=0 assignment — masked means
+    # over REAL tokens only.
+    n_live = jnp.maximum(live.sum(), 1.0)
+    f = masks[0].sum(axis=(0, 1)) / n_live               # [E]
+    p = (probs * live[..., None]).sum(axis=(0, 1)) / n_live
+    aux = E * jnp.sum(f * p)
+
+    if no_drop:
+        # Exact per-token mixture: every expert computed for every
+        # token, combined by normalized top-k gates. E x the MLP FLOPs,
+        # used on (cheap) inference paths only.
+        gate_mat = sum(g[..., None] * m for g, m in zip(gates, masks))
+        denom_all = jnp.maximum(sum(gates), 1e-9)        # [B, L]
+        w = gate_mat / denom_all[..., None]              # [B, L, E]
+        h = jnp.einsum("bld,edm->belm", x.astype(dtype),
+                       wi.astype(dtype))
         h = nn.gelu(h, approximate=True)
-        out = jnp.einsum("ebcm,emd->ebcd", h, wo.astype(self.dtype))
-        y = jnp.einsum("blec,ebcd->bld", combine.astype(self.dtype), out)
-        return y.astype(x.dtype)
+        out = jnp.einsum("belm,emd->beld", h, wo.astype(dtype))
+        y = jnp.einsum("ble,beld->bld", w.astype(dtype), out)
+        return y.astype(x.dtype), aux, None
+
+    # Capacity: interleave the K claim streams in (position, k) order —
+    # [B, L, K, E] -> [B, L*K, E] position-major — so slot occupancy at
+    # position j counts ONLY claims from positions <= j (causality).
+    claims = jnp.stack(masks, axis=2).reshape(B, L * K, E)
+    pos = jnp.cumsum(claims, axis=1) - claims            # [B, L*K, E]
+    keep_flat = claims * (pos < C)
+    slot_idx = (pos * keep_flat).sum(-1).astype(jnp.int32)
+    slot_flat = jax.nn.one_hot(slot_idx, C, dtype=jnp.float32)
+    keep = keep_flat.reshape(B, L, K, E)
+    slot = slot_flat.reshape(B, L, K, C)
+
+    # Normalize kept gates so the combine weights sum to <= 1.
+    kept_gate = [g * keep[:, :, k].sum(-1) for k, g in enumerate(gates)]
+    denom = jnp.maximum(sum(kept_gate), 1e-9)
+    combine = jnp.zeros((B, L, E, C), jnp.float32)
+    for k, g in enumerate(gates):
+        w = (g / denom)[..., None] * keep[:, :, k]       # [B, L, E]
+        combine = combine + w[..., None] * slot[:, :, k][:, :, None, :]
+    dispatch = (combine > 0).astype(x.dtype)
+
+    # Dispatch -> expert MLPs -> combine. The expert (e) dim of wi/wo is
+    # sharded over the mesh's expert axis; ein-summing it against
+    # batch-sharded activations is what makes XLA emit the all-to-alls.
+    xin = jnp.einsum("blec,bld->ebcd", dispatch, x.astype(dtype))
+    h = jnp.einsum("ebcd,edm->ebcm", xin, wi.astype(dtype))
+    h = nn.gelu(h, approximate=True)
+    out = jnp.einsum("ebcm,emd->ebcd", h, wo.astype(dtype))
+    y = jnp.einsum("blec,ebcd->bld", combine.astype(dtype), out)
+    return y.astype(x.dtype), aux, dispatch

@@ -76,7 +76,8 @@ STACKED_AXES = {
     "wo": (LAYERS, MLP, EMBED),
 }
 
-__all__ = ["PipelinedBlocks", "block_fwd"]
+__all__ = ["PipelinedBlocks", "MoEScanBlocks", "block_fwd", "block_attn",
+           "stage_apply"]
 
 
 def _layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
@@ -96,6 +97,19 @@ def _block_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     return x + jnp.einsum("blm,md->bld", h, lp["wo"].astype(dtype))
 
 
+def block_attn(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
+               pad_mask: Optional[jnp.ndarray], *, num_heads: int,
+               dtype: jnp.dtype, causal: bool, attention_impl: str = "xla"):
+    """The pre-LN attention half of a block (ln1 + self-attention +
+    residual) as a pure function; returns ``(x, (k, v))``."""
+    h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
+    qkv = jnp.einsum("bld,dthk->tbhlk", h, lp["qkv"].astype(dtype))
+    o = dot_product_attention(qkv[0], qkv[1], qkv[2], pad_mask,
+                              causal=causal, impl=attention_impl)
+    x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
+    return x, (qkv[1], qkv[2])
+
+
 def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
               pad_mask: Optional[jnp.ndarray], *, num_heads: int,
               dtype: jnp.dtype, causal: bool,
@@ -104,16 +118,11 @@ def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     (the stacked-per-layer slice) — the math of backbone.Block.
     ``return_kv=True`` also returns this layer's (k, v) [B, H, L, Dh]
     (the KV-cache prefill path)."""
-    B, L, D = x.shape
-    H = num_heads
-    h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
-    qkv = jnp.einsum("bld,dthk->tbhlk", h, lp["qkv"].astype(dtype))
-    o = dot_product_attention(qkv[0], qkv[1], qkv[2], pad_mask,
-                              causal=causal, impl=attention_impl)
-    x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
+    x, kv = block_attn(lp, x, pad_mask, num_heads=num_heads, dtype=dtype,
+                       causal=causal, attention_impl=attention_impl)
     out = _block_mlp(lp, x, dtype)
     if return_kv:
-        return out, (qkv[1], qkv[2])
+        return out, kv
     return out
 
 
@@ -134,6 +143,134 @@ def block_decode_step(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     o = dot_product_attention(q, ck, cv, live, causal=False, impl="xla")
     x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
     return _block_mlp(lp, x, dtype), ck, cv
+
+
+class MoEScanBlocks(nn.Module):
+    """Stacked (scan_layers) blocks where every ``moe_every``-th block's
+    MLP is a top-k routed mixture of experts: one scan over
+    ``G = num_layers / moe_every`` GROUPS, each group tracing
+    ``moe_every - 1`` dense blocks (inner scan) plus one MoE block —
+    the static branch pattern that makes MoE-every-k expressible under a
+    layer scan (a single homogeneous stack cannot alternate MLP kinds).
+
+    Expert parallelism composes: the stacked expert weights carry the
+    ``expert`` logical dim (-> mesh expert axis) exactly like the
+    named-blocks MoEMlp, and the MoE math IS moe_mlp_fwd — the same pure
+    function named blocks call, so parity holds by construction (pinned
+    by tests/test_pipeline.py's transplant test). ``pipe > 1`` is
+    rejected (expert dispatch inside pipeline stages is future work) and
+    there is no KV-cache decode path (sampling falls back to the
+    full-recompute forward, models/sampling.py)."""
+
+    num_layers: int
+    num_heads: int
+    hidden_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    causal: bool = False
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    moe_no_drop: bool = False
+    capacity_factor: float = 1.25  # MoEMlp's default — parity
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 pad_mask: Optional[jnp.ndarray] = None,
+                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        from .moe import EXPERT, moe_mlp_fwd
+
+        if cache_index is not None:
+            raise ValueError("MoE scan blocks have no KV-cache decode "
+                             "path; sample with use_cache=False")
+        from ..parallel.ring import current_mesh
+        mesh = current_mesh()
+        if (mesh is not None and mesh.shape.get("pipe", 1) > 1
+                and not self.is_initializing()):
+            raise ValueError(
+                "scan_layers MoE does not compose with pipe > 1 yet "
+                "(expert dispatch inside pipeline stages); drop --pipe or "
+                "--moe_experts")
+        Lc, D, H = self.num_layers, self.hidden_size, self.num_heads
+        assert D == x.shape[-1], (D, x.shape)
+        Dh, M, E = D // H, 4 * D, self.moe_experts
+        me = self.moe_every
+        if Lc % me:
+            raise ValueError(f"num_layers {Lc} not divisible by moe_every "
+                             f"{me} (scan groups must be uniform)")
+        G, nd = Lc // me, me - 1
+
+        per_layer = {
+            "ln1_scale": (nn.initializers.ones, (D,)),
+            "ln1_bias": (nn.initializers.zeros, (D,)),
+            "qkv": (_dense_init(D), (D, 3, H, Dh)),
+            "out": (_dense_init(D), (H, Dh, D)),
+            "ln2_scale": (nn.initializers.ones, (D,)),
+            "ln2_bias": (nn.initializers.zeros, (D,)),
+        }
+        dense_shapes = {**per_layer,
+                        "wi": (_dense_init(D), (D, M)),
+                        "wo": (_dense_init(M), (M, D))}
+        dense_lp = {
+            name: self.param(
+                f"dense_{name}", nn.with_logical_partitioning(
+                    init, (LAYERS, None) + STACKED_AXES[name][1:]),
+                (G, nd) + shape, jnp.float32)
+            for name, (init, shape) in dense_shapes.items()} if nd else {}
+        moe_lp = {
+            name: self.param(
+                f"moe_{name}", nn.with_logical_partitioning(
+                    init, STACKED_AXES[name]),
+                (G,) + shape, jnp.float32)
+            for name, (init, shape) in per_layer.items()}
+        moe_lp["router"] = self.param(
+            "moe_router", nn.with_logical_partitioning(
+                _dense_init(D), (LAYERS, EMBED, None)),
+            (G, D, E), jnp.float32)
+        moe_lp["wi"] = self.param(
+            "moe_wi", nn.with_logical_partitioning(
+                _dense_init(D), (LAYERS, EXPERT, EMBED, MLP)),
+            (G, E, D, M), jnp.float32)
+        moe_lp["wo"] = self.param(
+            "moe_wo", nn.with_logical_partitioning(
+                _dense_init(M), (LAYERS, EXPERT, MLP, EMBED)),
+            (G, E, M, D), jnp.float32)
+
+        def group(h, xs):
+            dlp, mlp_ = xs
+
+            def dense_layer(h, one):
+                return block_fwd(one, h, pad_mask, num_heads=H,
+                                 dtype=self.dtype, causal=self.causal,
+                                 attention_impl=self.attention_impl), None
+
+            def moe_block(h):
+                h, _ = block_attn(mlp_, h, pad_mask, num_heads=H,
+                                  dtype=self.dtype, causal=self.causal,
+                                  attention_impl=self.attention_impl)
+                hh = _layernorm(h, mlp_["ln2_scale"],
+                                mlp_["ln2_bias"]).astype(self.dtype)
+                y, aux, _ = moe_mlp_fwd(
+                    {"router": mlp_["router"], "wi": mlp_["wi"],
+                     "wo": mlp_["wo"]}, hh, pad_mask,
+                    top_k=self.moe_top_k,
+                    capacity_factor=self.capacity_factor,
+                    dtype=self.dtype, no_drop=self.moe_no_drop)
+                return h + y, aux
+
+            if self.remat:
+                dense_layer = jax.checkpoint(dense_layer, prevent_cse=False)
+                moe_block = jax.checkpoint(moe_block, prevent_cse=False)
+            if nd:
+                h, _ = jax.lax.scan(dense_layer, h, dlp)
+            h, aux = moe_block(h)
+            return h, aux
+
+        x, auxs = jax.lax.scan(group, x, (dense_lp, moe_lp))
+        self.sow("losses", "moe_aux", jnp.sum(auxs),
+                 init_fn=lambda: jnp.zeros(()), reduce_fn=jnp.add)
+        return x
 
 
 def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,

@@ -214,6 +214,10 @@ def gpt2_decode(workload, params, ids: jnp.ndarray,
             # forward decodes identically, just O(L^2) per token (keeps
             # --pipe N --eval_decode training runs alive)
             use_cache = False
+        if getattr(workload.model, "moe_experts", 0) > 0:
+            # MoEScanBlocks has no KV cache either — same identical-output
+            # full-recompute fallback
+            use_cache = False
     # Inference never drops MoE tokens (capacity competition is a training
     # device; per-token top-k routing at decode time is exact and makes the
     # cached and uncached paths bit-identical — models/moe.py).
