@@ -35,10 +35,12 @@ Design:
   with ``fsdp`` (ZeRO-3-inside-PP: each stage's weight slice shards over
   the fsdp axis on its embed dim, is all-gathered before the stage's layer
   scan, and the gather's AD transpose reduce-scatters the weight grads back
-  to the shard; fsdp ranks consume distinct batch shards). ``tensor``/
-  ``sequence`` > 1 alongside ``pipe`` > 1 is still rejected (ring-in-stage
-  and in-stage TP come later);
-  MoE is not yet available in stacked mode (the factory rejects it).
+  to the shard; fsdp ranks consume distinct batch shards) AND with
+  ``tensor`` (Megatron in-stage TP: heads/mlp weight dims shard over the
+  tensor axis and block_fwd all-reduces the two partial projections —
+  ``tp=True``). ``sequence`` > 1 alongside ``pipe`` > 1 is still rejected
+  (ring-in-stage is future work); MoE composes with the scan path via
+  :class:`MoEScanBlocks` (group scan) but not with ``pipe`` > 1 yet.
   KV-cache decode works in stacked mode at ``pipe == 1`` (``decode=True``,
   mirroring backbone.SelfAttention's contract); under ``pipe > 1`` the
   sampler falls back to the full-recompute gpipe forward.
@@ -77,7 +79,7 @@ STACKED_AXES = {
 }
 
 __all__ = ["PipelinedBlocks", "MoEScanBlocks", "block_fwd", "block_attn",
-           "stage_apply"]
+           "stage_apply", "stacked_specs"]
 
 
 def _layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
@@ -89,38 +91,88 @@ def _layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
+# --- in-stage tensor parallelism (Megatron recipe) -----------------------
+# tp mode "ad": raw lax.psum after the row-parallel projections; reverse-
+#   mode AD through shard_map (the GPipe path) transposes it correctly.
+# tp mode "manual": the f/g conjugate operator pair for code whose backward
+#   is written BY HAND against identical-per-rank cotangents (the 1F1B
+#   engine's jax.vjp calls): f all-reduces forward and is identity
+#   backward (the arriving cotangent already is the full dL/dy — the
+#   replicated downstream is ONE computation, not t); g is identity
+#   forward and all-reduces backward (the replicated ln output feeds t
+#   per-rank partial paths whose cotangents must sum). With f/g, every
+#   non-sharded value and cotangent in the engine is identical across
+#   tensor ranks and no further tensor reductions are needed.
+
+
+@jax.custom_vjp
+def _tp_f(y):
+    return jax.lax.psum(y, "tensor")
+
+
+_tp_f.defvjp(lambda y: (jax.lax.psum(y, "tensor"), None),
+             lambda _, ct: (ct,))
+
+
+@jax.custom_vjp
+def _tp_g(x):
+    return x
+
+
+_tp_g.defvjp(lambda x: (x, None),
+             lambda _, ct: (jax.lax.psum(ct, "tensor"),))
+
+
+def _tp_ops(tp):
+    """(gate_in, reduce_out) for a column->row parallel pair."""
+    if tp == "manual":
+        return _tp_g, _tp_f
+    if tp:  # "ad" (or legacy True)
+        return (lambda x: x), (lambda y: jax.lax.psum(y, "tensor"))
+    return (lambda x: x), (lambda y: y)
+
+
 def _block_mlp(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
-               dtype: jnp.dtype) -> jnp.ndarray:
-    h = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dtype)
+               dtype: jnp.dtype, tp=False) -> jnp.ndarray:
+    gate, reduce_ = _tp_ops(tp)
+    h = gate(_layernorm(x, lp["ln2_scale"], lp["ln2_bias"]).astype(dtype))
     h = jnp.einsum("bld,dm->blm", h, lp["wi"].astype(dtype))
     h = nn.gelu(h, approximate=True)
-    return x + jnp.einsum("blm,md->bld", h, lp["wo"].astype(dtype))
+    y = reduce_(jnp.einsum("blm,md->bld", h, lp["wo"].astype(dtype)))
+    return x + y
 
 
 def block_attn(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
                pad_mask: Optional[jnp.ndarray], *, num_heads: int,
-               dtype: jnp.dtype, causal: bool, attention_impl: str = "xla"):
+               dtype: jnp.dtype, causal: bool, attention_impl: str = "xla",
+               tp=False):
     """The pre-LN attention half of a block (ln1 + self-attention +
-    residual) as a pure function; returns ``(x, (k, v))``."""
-    h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
+    residual) as a pure function; returns ``(x, (k, v))``. ``tp`` (only
+    valid inside a shard_map body with a live ``tensor`` axis, see
+    ``_tp_ops``) runs Megatron-style: ``lp``'s heads dim holds this
+    rank's H/t heads and the out-projection's partial sums are
+    all-reduced over ``tensor``."""
+    gate, reduce_ = _tp_ops(tp)
+    h = gate(_layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype))
     qkv = jnp.einsum("bld,dthk->tbhlk", h, lp["qkv"].astype(dtype))
     o = dot_product_attention(qkv[0], qkv[1], qkv[2], pad_mask,
                               causal=causal, impl=attention_impl)
-    x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
-    return x, (qkv[1], qkv[2])
+    y = reduce_(jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype)))
+    return x + y, (qkv[1], qkv[2])
 
 
 def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
               pad_mask: Optional[jnp.ndarray], *, num_heads: int,
               dtype: jnp.dtype, causal: bool,
-              attention_impl: str = "xla", return_kv: bool = False):
+              attention_impl: str = "xla", return_kv: bool = False,
+              tp=False):
     """One pre-LN transformer block as a pure function of its param dict
     (the stacked-per-layer slice) — the math of backbone.Block.
     ``return_kv=True`` also returns this layer's (k, v) [B, H, L, Dh]
-    (the KV-cache prefill path)."""
+    (the KV-cache prefill path); ``tp`` see :func:`block_attn`."""
     x, kv = block_attn(lp, x, pad_mask, num_heads=num_heads, dtype=dtype,
-                       causal=causal, attention_impl=attention_impl)
-    out = _block_mlp(lp, x, dtype)
+                       causal=causal, attention_impl=attention_impl, tp=tp)
+    out = _block_mlp(lp, x, dtype, tp=tp)
     if return_kv:
         return out, kv
     return out
@@ -273,8 +325,44 @@ class MoEScanBlocks(nn.Module):
         return x
 
 
+def stacked_specs(mesh, lp: Dict[str, jnp.ndarray]):
+    """shard_map PartitionSpecs for stacked stage weights, plus the fsdp
+    gather map and the in-stage-TP flag: ``pipe`` on the layers dim,
+    ``fsdp`` on the embed dim (when divisible — mirroring
+    sharding.param_shardings' fallback), and ``tensor`` on the heads/mlp
+    dims (Megatron in-stage TP; tensor > 1 demands exact divisibility —
+    silently replicating would make block_fwd's tp psums double-count).
+    Shared by the GPipe schedule and the 1F1B engine so the weight layout
+    rules exist once."""
+    from jax.sharding import PartitionSpec as P
+
+    F, T = mesh.shape["fsdp"], mesh.shape["tensor"]
+    gather = {k: d for k, d in PipelinedBlocks._FSDP_DIM.items()
+              if F > 1 and lp[k].shape[d] % F == 0}
+    if T > 1:
+        H, M = lp["qkv"].shape[3], lp["wi"].shape[2]
+        if H % T or M % T:
+            raise ValueError(
+                f"in-stage tensor parallelism needs heads ({H}) and the "
+                f"mlp width ({M}) divisible by the tensor axis ({T})")
+
+    def wspec(name):
+        axes = STACKED_AXES[name]
+        dims = ["pipe"] + [None] * (len(axes) - 1)
+        if name in gather:
+            dims[gather[name]] = "fsdp"
+        if T > 1:
+            for i, ax in enumerate(axes):
+                if ax in (HEADS, MLP):
+                    dims[i] = "tensor"
+        return P(*dims)
+
+    return {k: wspec(k) for k in lp}, gather, T > 1
+
+
 def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
-                attention_impl: str, remat: bool, gather: Dict[str, int]):
+                attention_impl: str, remat: bool, gather: Dict[str, int],
+                tp=False):
     """Apply one pipeline stage's stacked layer slice to ``h``:
     ``block_fwd`` scanned over the leading layers dim. ``gather`` maps
     weight names to their fsdp-sharded dim (STACKED_AXES embed dims);
@@ -299,7 +387,7 @@ def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
                                        tiled=True) if k in gather else v)
                 for k, v in one.items()}
         return block_fwd(one, h, mask, num_heads=num_heads, dtype=dtype,
-                         causal=causal, attention_impl=impl), None
+                         causal=causal, attention_impl=impl, tp=tp), None
 
     if remat:
         layer = jax.checkpoint(layer, prevent_cse=False)
@@ -322,9 +410,12 @@ class PipelinedBlocks(nn.Module):
     decode: bool = False  # KV-cache generation (scan_layers, pipe == 1)
 
     def _impl(self) -> str:
-        # "auto"/"ring" would consult the ambient mesh from inside the
-        # pipeline's shard_map — resolve them to the dense kernel here;
-        # an explicit "pallas"/"xla" choice is honored.
+        # Inside the GPipe shard_map, "auto"/"ring" would consult the
+        # ambient mesh from a manual-sharding context — resolve them to the
+        # dense kernel there; an explicit "pallas"/"xla" choice is honored.
+        # The pipe == 1 scan path runs OUTSIDE shard_map and passes
+        # self.attention_impl through unclamped, so "auto" still picks
+        # flash at long context / ring under a sequence mesh.
         return (self.attention_impl
                 if self.attention_impl in ("xla", "pallas") else "xla")
 
@@ -366,7 +457,7 @@ class PipelinedBlocks(nn.Module):
             def layer(h, one):
                 return block_fwd(one, h, pad_mask, num_heads=H,
                                  dtype=self.dtype, causal=self.causal,
-                                 attention_impl=self._impl()), None
+                                 attention_impl=self.attention_impl), None
 
             if self.remat:
                 layer = jax.checkpoint(layer, prevent_cse=False)
@@ -403,7 +494,7 @@ class PipelinedBlocks(nn.Module):
             def layer(h, one):
                 out, kv = block_fwd(one, h, pad_mask, num_heads=H,
                                     dtype=self.dtype, causal=True,
-                                    attention_impl=self._impl(),
+                                    attention_impl=self.attention_impl,
                                     return_kv=True)
                 return out, kv
 
@@ -442,11 +533,12 @@ class PipelinedBlocks(nn.Module):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        for ax in ("tensor", "sequence"):
-            if mesh.shape[ax] > 1:
-                raise ValueError(
-                    f"pipeline parallelism v1 composes with data/fsdp/expert "
-                    f"axes only; mesh has {ax}={mesh.shape[ax]}")
+        if mesh.shape["sequence"] > 1:
+            raise ValueError(
+                f"pipeline parallelism v1 composes with data/fsdp/tensor/"
+                f"expert axes only; mesh has "
+                f"sequence={mesh.shape['sequence']} (ring-in-stage is "
+                f"future work)")
         if self.num_layers % S:
             raise ValueError(f"num_layers {self.num_layers} not divisible "
                              f"by pipe axis {S}")
@@ -466,27 +558,19 @@ class PipelinedBlocks(nn.Module):
         if (B // n_b) % M:
             raise ValueError(
                 f"per-shard batch {B // n_b} not divisible by pp_chunks {M}")
-        # ZeRO-3-inside-PP: each stage's weight slice additionally shards
-        # over fsdp on its embed dim (when divisible — mirroring
-        # sharding.param_shardings' fallback), is all-gathered before the
-        # layer scan, and AD's transpose reduce-scatters the weight grads
-        # back to the shard. FSDP ranks consume distinct batch shards.
-        F = mesh.shape["fsdp"]
-        gather = {k: d for k, d in self._FSDP_DIM.items()
-                  if F > 1 and lp[k].shape[d] % F == 0}
-
-        def wspec(name, a):
-            dims = ["pipe"] + [None] * (a.ndim - 1)
-            if name in gather:
-                dims[gather[name]] = "fsdp"
-            return P(*dims)
-
-        pspec = {k: wspec(k, a) for k, a in lp.items()}
+        # ZeRO-3-inside-PP + Megatron-in-stage-TP: each stage's weight
+        # slice additionally shards over fsdp on its embed dim (gathered
+        # before the layer scan; AD's transpose reduce-scatters the weight
+        # grads) and over tensor on its heads/mlp dims (block_fwd's tp
+        # psums all-reduce the partial projections). FSDP ranks consume
+        # distinct batch shards; tensor ranks share one.
+        pspec, gather, tp = stacked_specs(mesh, lp)
+        tp = "ad" if tp else False  # shard_map AD transposes raw psums
         x3 = P(batch_axes or None, None, None)
         m2 = P(batch_axes or None, None)
 
         fn = shard_map(
-            functools.partial(self._schedule, M=M, gather=gather),
+            functools.partial(self._schedule, M=M, gather=gather, tp=tp),
             mesh=mesh,
             in_specs=(pspec, x3, m2),
             out_specs=x3,
@@ -496,7 +580,7 @@ class PipelinedBlocks(nn.Module):
         return fn(lp, x, pad_mask)
 
     def _schedule(self, lp_local, x_local, mask_local, *, M: int,
-                  gather: Dict[str, int]):
+                  gather: Dict[str, int], tp: bool = False):
         """Per-device GPipe schedule; lp_local holds THIS stage's layers
         (fsdp-sharded weights are all-gathered before use; the transpose of
         the gather reduce-scatters their grads — ZeRO-3 semantics).
@@ -526,7 +610,7 @@ class PipelinedBlocks(nn.Module):
             return stage_apply(lp_local, h, mask, num_heads=self.num_heads,
                                dtype=self.dtype, causal=self.causal,
                                attention_impl=self._impl(),
-                               remat=self.remat, gather=gather)
+                               remat=self.remat, gather=gather, tp=tp)
 
         def tick(carry, t):
             recv, outs = carry

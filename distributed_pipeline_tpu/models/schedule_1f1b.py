@@ -141,10 +141,21 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
 
     bspec = P(batch_axes or None)
     rep = P()
+    # Per-key grad reductions: a weight's cotangent must be psum'd over
+    # every BATCH axis its spec does not shard it across (distinct data
+    # shards on data/expert/fsdp). fsdp-gathered keys already
+    # reduce-scattered inside the stage vjp. The tensor axis never needs
+    # summing here: stage_fn runs in "manual" tp mode, whose f/g operator
+    # pair (pipeline._tp_ops) keeps every non-sharded value AND cotangent
+    # identical across tensor ranks (sharded keys hold per-shard grads).
+    lp_reduce = {
+        k: tuple(a for a in ("data", "expert", "fsdp")
+                 if a not in tuple(spec))
+        for k, spec in lp_specs.items()}
     body = functools.partial(
         _schedule_body, S=S, M=M, K=K, T=T, stage_fn=stage_fn,
         pre_fn=pre_fn, mask_fn=mask_fn, head_fn=head_fn,
-        gathered=frozenset(k for k, s in lp_specs.items() if "fsdp" in s))
+        lp_reduce=lp_reduce)
 
     fwd = shard_map(
         body, mesh=mesh,
@@ -174,7 +185,8 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
 
 
 def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
-                   S, M, K, T, stage_fn, pre_fn, mask_fn, head_fn, gathered):
+                   S, M, K, T, stage_fn, pre_fn, mask_fn, head_fn,
+                   lp_reduce):
     """Per-device combined F+B scan (module docstring). Runs inside
     shard_map; ``lp_local`` is this stage's (possibly fsdp-sharded) layer
     slice."""
@@ -235,6 +247,16 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
         stash = jax.lax.dynamic_update_index_in_dim(
             stash, jnp.where(vf, h_in, prev), slot_w, 0)
 
+        # Send the forward activation now and TIE the B slot behind it:
+        # send_f has no data dependence on any B-slot work, so without the
+        # barrier the runtime may race this pipe ppermute against the B
+        # slot's fsdp/tensor collectives from OTHER cliques — on small
+        # hosts the in-process CPU communicator then starves its rendezvous
+        # and aborts. The tie keeps one collective chain in flight per
+        # tick (h_out feeds every B-slot path, directly or via the cond).
+        send_f = jax.lax.ppermute(h_out, "pipe", perm_f)
+        send_f, h_out = jax.lax.optimization_barrier((send_f, h_out))
+
         # ---- loss head: only the last stage's value is real (b == f
         # there, so h_out IS chunk b's blocks output); lax.cond skips the
         # flops elsewhere at runtime. No collectives inside.
@@ -272,7 +294,6 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
         loss = loss + jnp.where(vb, lc, 0.0)
         metrics = _tree_add(metrics, _tree_where(vb, mc))
 
-        send_f = jax.lax.ppermute(h_out, "pipe", perm_f)
         send_b = jax.lax.ppermute(d_h_in, "pipe", perm_b)
         return (send_f, send_b, stash, d_lp, d_rest, d_diff, loss,
                 metrics), None
@@ -297,9 +318,7 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
     # on every device). Gathered weights' fsdp reduce-scatter already
     # happened inside svjp (the transpose of the per-layer all_gather);
     # everything else sums explicitly.
-    batch_red = ("data", "expert")
-    d_lp = {k: jax.lax.psum(g if k in gathered else jax.lax.psum(g, "fsdp"),
-                            batch_red)
+    d_lp = {k: (jax.lax.psum(g, lp_reduce[k]) if lp_reduce[k] else g)
             for k, g in d_lp.items()}
     full_red = ("data", "fsdp", "expert", "pipe")
     d_rest = jax.lax.psum(d_rest, full_red)
@@ -321,46 +340,26 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
 # --------------------------------------------------------------------------
 
 
-def _stage_fn_for(model, gather, causal: bool):
+def _stage_fn_for(model, gather, causal: bool, tp: bool):
     """This stage's layer stack as a pure fn: pipeline.stage_apply (the
-    same body the GPipe schedule uses — the gather/remat/impl policy lives
-    in ONE place) with the model's static attributes bound. The fsdp
-    gathers inside make jax.vjp emit the matching reduce-scatter (ZeRO-3
-    grad semantics)."""
+    same body the GPipe schedule uses — the gather/remat/impl/tp policy
+    lives in ONE place) with the model's static attributes bound. The
+    fsdp gathers and tp psums inside make jax.vjp emit the matching
+    reduce-scatter / per-shard grads (ZeRO-3 + Megatron semantics)."""
     from .pipeline import stage_apply
 
     return functools.partial(
         stage_apply, num_heads=model.num_heads, dtype=model.dtype,
         causal=causal, attention_impl=model.attention_impl,
-        remat=model.remat, gather=gather)
-
-
-def _lp_specs_and_gather(mesh, lp):
-    """shard_map specs for the stacked stage weights: pipe on the layers
-    dim, fsdp on the embed dim when divisible — the _gpipe rules."""
-    from jax.sharding import PartitionSpec as P
-
-    from .pipeline import PipelinedBlocks
-
-    F = mesh.shape["fsdp"]
-    gather = {k: d for k, d in PipelinedBlocks._FSDP_DIM.items()
-              if F > 1 and lp[k].shape[d] % F == 0}
-
-    def wspec(name, a):
-        dims = ["pipe"] + [None] * (a.ndim - 1)
-        if name in gather:
-            dims[gather[name]] = "fsdp"
-        return P(*dims)
-
-    return {k: wspec(k, a) for k, a in lp.items()}, gather
+        remat=model.remat, gather=gather, tp=tp)
 
 
 def _check_pipe_mesh(mesh):
-    for ax in ("tensor", "sequence"):
-        if mesh.shape[ax] > 1:
-            raise ValueError(
-                f"pipeline parallelism v1 composes with data/fsdp/expert "
-                f"axes only; mesh has {ax}={mesh.shape[ax]}")
+    if mesh.shape["sequence"] > 1:
+        raise ValueError(
+            f"pipeline parallelism v1 composes with data/fsdp/tensor/"
+            f"expert axes only; mesh has sequence="
+            f"{mesh.shape['sequence']} (ring-in-stage is future work)")
 
 
 def gpt2_1f1b_losses(model, params, batch) -> Dict[str, jnp.ndarray]:
@@ -403,10 +402,13 @@ def gpt2_1f1b_losses(model, params, batch) -> Dict[str, jnp.ndarray]:
         return loss_sum.astype(jnp.float32), {
             "acc": ((hit * lm).sum() * sc["inv_denom"]).astype(jnp.float32)}
 
-    lp_specs, gather = _lp_specs_and_gather(mesh, lp)
+    from .pipeline import stacked_specs
+    lp_specs, gather, tp = stacked_specs(mesh, lp)
     loss, metrics = pipelined_loss(
         mesh, lp, rest, {}, aux, {"inv_denom": inv_denom},
-        pp_chunks=model.pp_chunks, stage_fn=_stage_fn_for(model, gather, causal=True),
+        pp_chunks=model.pp_chunks,
+        stage_fn=_stage_fn_for(model, gather, causal=True,
+                               tp="manual" if tp else False),
         pre_fn=pre_fn, mask_fn=lambda ac: ac["pad"], head_fn=head_fn,
         lp_specs=lp_specs)
     return {"loss": loss, "nll": loss, "acc": metrics["acc"],
@@ -472,11 +474,14 @@ def diffuseq_1f1b_losses(model, schedule, params, batch,
         loss_sum = (per * ac["tm"]).sum() * sc["inv_tgt"]
         return loss_sum.astype(jnp.float32), {}
 
-    lp_specs, gather = _lp_specs_and_gather(mesh, lp)
+    from .pipeline import stacked_specs
+    lp_specs, gather, tp = stacked_specs(mesh, lp)
     mse, _ = pipelined_loss(
         mesh, lp, rest, {"x_t": x_t, "x_start": x_start},
         {"t": t, "pad": pad_mask, "tm": tgt_mask}, {"inv_tgt": inv_tgt},
-        pp_chunks=model.pp_chunks, stage_fn=_stage_fn_for(model, gather, causal=False),
+        pp_chunks=model.pp_chunks,
+        stage_fn=_stage_fn_for(model, gather, causal=False,
+                               tp="manual" if tp else False),
         pre_fn=pre_fn, mask_fn=lambda ac: ac["pad"], head_fn=head_fn,
         lp_specs=lp_specs)
 

@@ -65,6 +65,10 @@ def create_distributed_parser() -> argparse.ArgumentParser:
                    help="seconds between worker liveness polls (reference "
                         "dist_run.py:130-136; default is snappier than "
                         "torchrun's 5s — these are local dev workers)")
+    p.add_argument("--log_dir", default="",
+                   help="capture each spawned worker's stdout+stderr to "
+                        "{log_dir}/worker_{i}.log (torchrun --log_dir/-r "
+                        "redirects, dist_run.py:163-189); restarts append")
     return p
 
 
@@ -103,7 +107,8 @@ def get_main_modname() -> Optional[str]:
 
 def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      monitor_interval: float,
-                     run_timestamp: Optional[str] = None) -> int:
+                     run_timestamp: Optional[str] = None,
+                     log_dir: str = "") -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -118,6 +123,10 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     print(f"[launcher] spawning {nprocs} local workers, coordinator {coord}")
     print(f"[launcher] worker cmd: {' '.join(cmd_base)}")  # cmdline echo,
     # like reference dist_run.py:36-44
+    logs = []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        print(f"[launcher] per-worker output -> {log_dir}/worker_N.log")
     procs = []
     for i in range(nprocs):
         env = dict(os.environ)
@@ -137,7 +146,15 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
             + (" " if env_flags else "")
             + f"--xla_force_host_platform_device_count={devices_per_proc}",
         })
-        procs.append(subprocess.Popen(cmd_base, env=env))
+        if log_dir:
+            # append: a restarted ring continues the same files (the
+            # attempt boundary is visible from the launcher's own log)
+            f = open(os.path.join(log_dir, f"worker_{i}.log"), "ab")
+            logs.append(f)
+            procs.append(subprocess.Popen(cmd_base, env=env, stdout=f,
+                                          stderr=subprocess.STDOUT))
+        else:
+            procs.append(subprocess.Popen(cmd_base, env=env))
     codes: List[Optional[int]] = [None] * len(procs)
     try:
         while any(c is None for c in codes):
@@ -164,6 +181,9 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
         for p in procs:
             p.terminate()
         raise
+    finally:
+        for f in logs:
+            f.close()
     # Any nonzero code fails the attempt — max() would mask a signal-killed
     # worker (negative returncode) behind a sibling's clean 0.
     return next((c for c in codes if c not in (None, 0)), 0)
@@ -172,7 +192,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
 def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             nprocs: int, devices_per_proc: int = 2,
                             max_restarts: int = 0,
-                            monitor_interval: float = 0.2) -> int:
+                            monitor_interval: float = 0.2,
+                            log_dir: str = "") -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
@@ -201,7 +222,8 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     attempt = 0
     while True:
         code = _run_worker_ring(cmd_base, nprocs, devices_per_proc,
-                                monitor_interval, run_timestamp)
+                                monitor_interval, run_timestamp,
+                                log_dir=log_dir)
         if code == 0 or attempt >= max_restarts:
             return code
         attempt += 1
@@ -233,7 +255,8 @@ def parse_and_autorun(
         code = run_argv_as_distributed(modname, script_argv, dist_ns.nprocs,
                                        dist_ns.devices_per_proc,
                                        max_restarts=dist_ns.max_restarts,
-                                       monitor_interval=dist_ns.monitor_interval)
+                                       monitor_interval=dist_ns.monitor_interval,
+                                       log_dir=dist_ns.log_dir)
         sys.exit(code)
 
     if dist_ns.distributed:

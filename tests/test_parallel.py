@@ -151,6 +151,27 @@ def test_launcher_spawns_real_multiprocess_ring():
     assert "RANK 0 OK" in out.stdout and "RANK 1 OK" in out.stdout
 
 
+def test_launcher_log_dir_captures_per_worker_output(tmp_path):
+    """--log_dir routes each worker's stdout+stderr into worker_{i}.log
+    (torchrun --log_dir redirects); the parent's stdout then carries only
+    launcher lines."""
+    import os
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log_dir = str(tmp_path / "wlogs")
+    out = subprocess.run(
+        [sys.executable, "-m", "tests._launcher_child",
+         "--distributed", "--nprocs", "2", "--log_dir", log_dir],
+        capture_output=True, text=True, timeout=120, cwd=repo_root,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "RANK" not in out.stdout  # worker output no longer on the pipe
+    logs = {i: open(os.path.join(log_dir, f"worker_{i}.log")).read()
+            for i in (0, 1)}
+    ranks = {i: next(ln for ln in logs[i].splitlines() if "OK" in ln)
+             for i in (0, 1)}
+    assert sorted(ranks.values()) == ["RANK 0 OK", "RANK 1 OK"], ranks
+
+
 def _run_train_child(tmp_path, extra, timeout=420):
     """Run the 2-process training child, retrying ONCE on a nonzero exit:
     the loopback jax.distributed ring's coordinator handshake can time out
