@@ -580,7 +580,8 @@ class PipelinedBlocks(nn.Module):
         return fn(lp, x, pad_mask)
 
     def _schedule(self, lp_local, x_local, mask_local, *, M: int,
-                  gather: Dict[str, int], tp: bool = False):
+                  gather: Dict[str, int], tp=False):
+        # tp domain: False | "ad" | "manual" — see _tp_ops
         """Per-device GPipe schedule; lp_local holds THIS stage's layers
         (fsdp-sharded weights are all-gathered before use; the transpose of
         the gather reduce-scatters their grads — ZeRO-3 semantics).

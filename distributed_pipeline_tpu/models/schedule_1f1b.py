@@ -252,10 +252,14 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
         # barrier the runtime may race this pipe ppermute against the B
         # slot's fsdp/tensor collectives from OTHER cliques — on small
         # hosts the in-process CPU communicator then starves its rendezvous
-        # and aborts. The tie keeps one collective chain in flight per
-        # tick (h_out feeds every B-slot path, directly or via the cond).
+        # and aborts. Tying lp_b (the weights the B-slot vjp re-gathers)
+        # as well as h_out covers every B-slot collective: the fsdp
+        # gathers inside svjp depend only on the weights, not on h_out.
+        # (Best-effort mitigation — the fake-device runtime can still
+        # abort under load; tests retry, real TPUs are in-order.)
         send_f = jax.lax.ppermute(h_out, "pipe", perm_f)
-        send_f, h_out = jax.lax.optimization_barrier((send_f, h_out))
+        send_f, h_out, lp_b = jax.lax.optimization_barrier(
+            (send_f, h_out, lp_local))
 
         # ---- loss head: only the last stage's value is real (b == f
         # there, so h_out IS chunk b's blocks output); lax.cond skips the
@@ -275,7 +279,7 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
                                               keepdims=False)
         mask_b = mask_fn(abc)
         _, svjp = jax.vjp(lambda w, h: stage_fn(w, h, mask_b),
-                          lp_local, h_in_b)
+                          lp_b, h_in_b)
         d_lp_c, d_h_in = svjp(cot_in)
 
         d_rest_p, d_diff_p = jax.lax.cond(

@@ -262,14 +262,18 @@ def test_pipeline_full_composition_fsdp_tensor_pipe(tmp_path):
               f"attempt {attempt + 1}/4) — XLA CPU in-process rendezvous "
               f"flake; stderr tail: {out.stderr[-300:]!r}")
     if out.returncode != 0:
-        # The abort rate scales with host load (each abort is the 40s
-        # rendezvous termination timeout firing); on a loaded 1-core
-        # machine all retries can lose the race. Skipping (loudly) beats
-        # a load-dependent red: the parity ASSERTION below still runs on
-        # every host where the child completes.
-        pytest.skip("XLA CPU in-process collective rendezvous aborted on "
-                    "all 4 attempts (fake-device infra flake, load-"
-                    "dependent; real TPUs execute collectives in order)")
+        # Skip ONLY the known infra signature — the 40s rendezvous
+        # termination timeout, whose abort rate scales with host load; a
+        # deterministic product regression (ValueError, shape mismatch,
+        # NaN crash) must still FAIL here, not skip.
+        if ("Termination timeout" in out.stderr
+                or "rendezvous" in out.stderr.lower()):
+            pytest.skip("XLA CPU in-process collective rendezvous aborted "
+                        "on all 4 attempts (fake-device infra flake, load-"
+                        "dependent; real TPUs execute collectives in order)")
+        raise AssertionError(
+            f"full-composition child failed (rc={out.returncode}), not a "
+            f"rendezvous flake:\n{out.stderr[-2000:]}")
     got = [float(x) for x in
            next(ln for ln in out.stdout.splitlines()
                 if ln.startswith("LOSSES")).split()[1:]]
