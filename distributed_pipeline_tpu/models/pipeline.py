@@ -42,8 +42,11 @@ Design:
   (ring-in-stage is future work); MoE composes with the scan path via
   :class:`MoEScanBlocks` (group scan) but not with ``pipe`` > 1 yet.
   KV-cache decode works in stacked mode at ``pipe == 1`` (``decode=True``,
-  mirroring backbone.SelfAttention's contract); under ``pipe > 1`` the
-  sampler falls back to the full-recompute gpipe forward.
+  mirroring backbone.SelfAttention's contract) AND under ``pipe > 1``
+  (``_decode_pipe``: the prefill collects pipe-sharded per-stage caches
+  inside the GPipe schedule, then each token takes S masked ring hops —
+  O(L) per token); only ``tensor > 1`` decoding falls back to the
+  full-recompute forward.
 
 The pure-function block forward here is numerically identical to
 backbone.Block (same pre-LN residual structure, f32 layernorm statistics,
@@ -362,7 +365,7 @@ def stacked_specs(mesh, lp: Dict[str, jnp.ndarray]):
 
 def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
                 attention_impl: str, remat: bool, gather: Dict[str, int],
-                tp=False):
+                tp=False, return_kv: bool = False):
     """Apply one pipeline stage's stacked layer slice to ``h``:
     ``block_fwd`` scanned over the leading layers dim. ``gather`` maps
     weight names to their fsdp-sharded dim (STACKED_AXES embed dims);
@@ -370,7 +373,10 @@ def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
     per-layer INSIDE the checkpointed body so gathered weights are
     rematerialized in the backward instead of saved as residuals. Shared
     by the GPipe schedule below and the 1F1B schedule
-    (models/schedule_1f1b.py) so the two paths cannot diverge."""
+    (models/schedule_1f1b.py) so the two paths cannot diverge.
+    ``return_kv=True`` additionally returns this stage's per-layer
+    (k, v) stacks [L_loc, B, H, L, Dh] — the pipe-sharded KV-cache
+    prefill (``_decode_pipe``)."""
     impl = attention_impl if attention_impl in ("xla", "pallas") else "xla"
     if gather and not remat:
         lp_local = {
@@ -386,13 +392,15 @@ def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
                 k: (jax.lax.all_gather(v, "fsdp", axis=gather[k] - 1,
                                        tiled=True) if k in gather else v)
                 for k, v in one.items()}
-        return block_fwd(one, h, mask, num_heads=num_heads, dtype=dtype,
-                         causal=causal, attention_impl=impl, tp=tp), None
+        out = block_fwd(one, h, mask, num_heads=num_heads, dtype=dtype,
+                        causal=causal, attention_impl=impl, tp=tp,
+                        return_kv=return_kv)
+        return out if return_kv else (out, None)
 
     if remat:
         layer = jax.checkpoint(layer, prevent_cse=False)
-    h, _ = jax.lax.scan(layer, h, lp_local)
-    return h
+    h, kv = jax.lax.scan(layer, h, lp_local)
+    return (h, kv) if return_kv else h
 
 
 class PipelinedBlocks(nn.Module):
@@ -446,9 +454,13 @@ class PipelinedBlocks(nn.Module):
         S = mesh.shape.get("pipe", 1) if mesh is not None else 1
         if self.decode and not self.is_initializing():
             if S > 1:
-                raise ValueError(
-                    "KV-cache decode is not available under a pipe > 1 "
-                    "mesh; generate on a {data}-only mesh")
+                if mesh.shape["tensor"] > 1:
+                    raise ValueError(
+                        "KV-cache decode under a pipe mesh does not "
+                        "support tensor > 1; the sampler falls back to "
+                        "the full-recompute forward")
+                return self._decode_pipe(mesh, S, lp, x, pad_mask,
+                                         cache_index)
             return self._decode(lp, x, pad_mask, cache_index)
         if S <= 1 or self.is_initializing():
             # init traces with a tiny dummy batch that can't be chunked;
@@ -465,6 +477,40 @@ class PipelinedBlocks(nn.Module):
             return x
         return self._gpipe(mesh, S, lp, x, pad_mask)
 
+    def _check_prefill_len(self, L: int) -> None:
+        if self.has_variable("cache", "key"):
+            # the named-blocks contract (backbone.py): full length is
+            # prefill, one token is a step — anything else is a bug;
+            # silently re-prefilling at a shorter L would clamp later
+            # cache writes into garbage continuations
+            Lmax = self.get_variable("cache", "key").shape[3]
+            if L != Lmax:
+                raise ValueError(
+                    f"decode calls take the full length ({Lmax}, "
+                    f"prefill) or a single token, got {L}")
+
+    def _cache_step_inputs(self, B, pad_mask, cache_index):
+        """Shared single-token contract for BOTH decode paths (pipe == 1
+        and _decode_pipe): the cache variables, the int32 write index, and
+        the live-prefix mask (causality for one query row, intersected
+        with padding)."""
+        if cache_index is None:
+            raise ValueError("single-token decode needs cache_index")
+
+        def _no_prefill():
+            raise ValueError("single-token decode before prefill: call the "
+                             "model once at full length first")
+
+        ck = self.variable("cache", "key", _no_prefill)
+        cv = self.variable("cache", "value", _no_prefill)
+        Lmax = ck.value.shape[3]
+        idx = jnp.asarray(cache_index, jnp.int32)
+        live = jnp.broadcast_to(
+            (jnp.arange(Lmax) <= idx).astype(jnp.int32)[None], (B, Lmax))
+        if pad_mask is not None:
+            live = live * pad_mask
+        return ck, cv, idx, live
+
     def _decode(self, lp, x, pad_mask, cache_index):
         """KV-cache generation over the stacked layers: a full-length call
         is the PREFILL (normal causal scan that also stores every layer's
@@ -475,21 +521,8 @@ class PipelinedBlocks(nn.Module):
         B, L, D = x.shape
         H = self.num_heads
 
-        def _no_prefill():
-            raise ValueError("single-token decode before prefill: call the "
-                             "model once at full length first")
-
         if L > 1:  # prefill
-            if self.has_variable("cache", "key"):
-                # the named-blocks contract (backbone.py): full length is
-                # prefill, one token is a step — anything else is a bug;
-                # silently re-prefilling at a shorter L would clamp later
-                # cache writes into garbage continuations
-                Lmax = self.get_variable("cache", "key").shape[3]
-                if L != Lmax:
-                    raise ValueError(
-                        f"decode calls take the full length ({Lmax}, "
-                        f"prefill) or a single token, got {L}")
+            self._check_prefill_len(L)
 
             def layer(h, one):
                 out, kv = block_fwd(one, h, pad_mask, num_heads=H,
@@ -502,16 +535,7 @@ class PipelinedBlocks(nn.Module):
             self.variable("cache", "key", lambda: ks).value = ks
             self.variable("cache", "value", lambda: vs).value = vs
             return x
-        if cache_index is None:
-            raise ValueError("single-token decode needs cache_index")
-        ck = self.variable("cache", "key", _no_prefill)
-        cv = self.variable("cache", "value", _no_prefill)
-        Lmax = ck.value.shape[3]
-        idx = jnp.asarray(cache_index, jnp.int32)
-        live = jnp.broadcast_to(
-            (jnp.arange(Lmax) <= idx).astype(jnp.int32)[None], (B, Lmax))
-        if pad_mask is not None:
-            live = live * pad_mask
+        ck, cv, idx, live = self._cache_step_inputs(B, pad_mask, cache_index)
 
         def layer(h, xs):
             one, k_l, v_l = xs
@@ -523,13 +547,100 @@ class PipelinedBlocks(nn.Module):
         ck.value, cv.value = ks, vs
         return x
 
+    def _decode_pipe(self, mesh, S, lp, x, pad_mask, cache_index):
+        """KV-cache generation under a ``pipe > 1`` mesh.
+
+        PREFILL (full-length call): the GPipe schedule runs with
+        ``collect_kv`` — each stage stores its OWN layers' K/V for every
+        chunk it streams, so the cache comes out naturally pipe-sharded
+        on its layers dim ([Lc, B, H, Lmax, Dh] globally, the same layout
+        as the pipe == 1 path). STEP (single-token call): the token takes
+        S masked hops around the pipe ring — every stage runs its local
+        cached-decode layer scan each hop, a ``where`` on stage == hop
+        keeps only the active stage's result, and a cyclic ``ppermute``
+        advances the activation; after S hops the final hidden state is
+        broadcast back with one masked psum. O(L) per token instead of
+        the O(L^2) full-recompute fallback. ``tensor > 1`` is rejected by
+        the caller (the decode step has no TP path)."""
+        B, L, D = x.shape
+
+        if L > 1:  # prefill
+            self._check_prefill_len(L)
+            out, ks, vs = self._gpipe(mesh, S, lp, x, pad_mask,
+                                      collect_kv=True)
+            self.variable("cache", "key", lambda: ks).value = ks
+            self.variable("cache", "value", lambda: vs).value = vs
+            return out
+        ck, cv, idx, live = self._cache_step_inputs(B, pad_mask, cache_index)
+        out, ck.value, cv.value = self._pipe_step(
+            mesh, S, lp, x, ck.value, cv.value, live, idx)
+        return out
+
+    def _pipe_step(self, mesh, S, lp, x, ck, cv, live, idx):
+        """One decode token through the pipe ring (docstring above)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        pspec, gather, _ = stacked_specs(mesh, lp)
+        batch_axes = tuple(a for a in ("data", "fsdp", "expert")
+                           if mesh.shape[a] > 1)
+        x3 = P(batch_axes or None, None, None)
+        kv5 = P("pipe", batch_axes or None, None, None, None)
+        m2 = P(batch_axes or None, None)
+        H = self.num_heads
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def body(lp_local, h, ck_l, cv_l, live_l, idx_):
+            sid = jax.lax.axis_index("pipe")
+            if gather:  # fsdp-sharded weights: gather the stage stack once
+                lp_local = {
+                    k: (jax.lax.all_gather(v, "fsdp", axis=gather[k],
+                                           tiled=True)
+                        if k in gather else v)
+                    for k, v in lp_local.items()}
+
+            def hop(carry, s):
+                h, ck_h, cv_h = carry
+
+                def lstep(hh, xs):
+                    one, k_l, v_l = xs
+                    out, k_l, v_l = block_decode_step(
+                        one, hh, k_l, v_l, idx_, live_l, num_heads=H,
+                        dtype=self.dtype)
+                    return out, (k_l, v_l)
+
+                h2, (ck2, cv2) = jax.lax.scan(lstep, h, (lp_local, ck_h,
+                                                         cv_h))
+                act = jnp.equal(sid, s)
+                h = jnp.where(act, h2, h)
+                ck_h = jnp.where(act, ck2, ck_h)
+                cv_h = jnp.where(act, cv2, cv_h)
+                # cyclic shift: stage s's processed activation lands on
+                # stage s+1 for the next hop
+                h = jax.lax.ppermute(h, "pipe", perm)
+                return (h, ck_h, cv_h), None
+
+            (h, ck_l, cv_l), _ = jax.lax.scan(
+                hop, (h, ck_l, cv_l), jnp.arange(S))
+            # after S cyclic shifts the last stage's output sits on stage 0
+            h = jax.lax.psum(
+                jnp.where(jnp.equal(sid, 0), h, jnp.zeros_like(h)), "pipe")
+            return h, ck_l, cv_l
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(pspec, x3, kv5, kv5, m2, P()),
+            out_specs=(x3, kv5, kv5),
+            check_vma=False)
+        return fn(lp, x, ck, cv, live, idx)
+
     # Which dim of each stacked weight carries the EMBED logical name —
     # the dim FSDP shards (parallel/sharding.py LOGICAL_RULES: embed->fsdp).
     # LayerNorm params have no embed dim and stay replicated over fsdp.
     _FSDP_DIM = {k: axes.index(EMBED) for k, axes in STACKED_AXES.items()
                  if EMBED in axes}
 
-    def _gpipe(self, mesh, S, lp, x, pad_mask):
+    def _gpipe(self, mesh, S, lp, x, pad_mask, collect_kv: bool = False):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -569,18 +680,21 @@ class PipelinedBlocks(nn.Module):
         x3 = P(batch_axes or None, None, None)
         m2 = P(batch_axes or None, None)
 
+        kv5 = P("pipe", batch_axes or None, None, None, None)
         fn = shard_map(
-            functools.partial(self._schedule, M=M, gather=gather, tp=tp),
+            functools.partial(self._schedule, M=M, gather=gather, tp=tp,
+                              collect_kv=collect_kv),
             mesh=mesh,
             in_specs=(pspec, x3, m2),
-            out_specs=x3,
+            out_specs=(x3, kv5, kv5) if collect_kv else x3,
             check_vma=False)
         if pad_mask is None:
             pad_mask = jnp.ones(x.shape[:2], jnp.int32)
         return fn(lp, x, pad_mask)
 
     def _schedule(self, lp_local, x_local, mask_local, *, M: int,
-                  gather: Dict[str, int], tp=False):
+                  gather: Dict[str, int], tp=False,
+                  collect_kv: bool = False):
         # tp domain: False | "ad" | "manual" — see _tp_ops
         """Per-device GPipe schedule; lp_local holds THIS stage's layers
         (fsdp-sharded weights are all-gathered before use; the transpose of
@@ -607,19 +721,35 @@ class PipelinedBlocks(nn.Module):
         mask_chunks = mask_local.reshape(M, cb, L)
         perm = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1
 
-        def apply_stage(h, mask):
+        def apply_stage(h, mask, return_kv=False):
             return stage_apply(lp_local, h, mask, num_heads=self.num_heads,
                                dtype=self.dtype, causal=self.causal,
                                attention_impl=self._impl(),
-                               remat=self.remat, gather=gather, tp=tp)
+                               remat=self.remat, gather=gather, tp=tp,
+                               return_kv=return_kv)
 
         def tick(carry, t):
-            recv, outs = carry
+            recv, outs, ckb, cvb = carry
             # chunk being processed by THIS stage at tick t is chunk t-sid;
             # its pad mask is input data (replicated over pipe), no permute.
             cidx = jnp.clip(t - sid, 0, M - 1)
+            valid = jnp.logical_and(t - sid >= 0, t - sid < M)
             inp = jnp.where(sid == 0, chunks[jnp.clip(t, 0, M - 1)], recv)
-            out = apply_stage(inp, mask_chunks[cidx])
+            if collect_kv:
+                out, (ks, vs) = apply_stage(inp, mask_chunks[cidx],
+                                            return_kv=True)
+                # this stage's layers' K/V for chunk cidx (bubble ticks
+                # keep the previous slot contents)
+                pk = jax.lax.dynamic_index_in_dim(ckb, cidx, 1,
+                                                  keepdims=False)
+                pv = jax.lax.dynamic_index_in_dim(cvb, cidx, 1,
+                                                  keepdims=False)
+                ckb = jax.lax.dynamic_update_index_in_dim(
+                    ckb, jnp.where(valid, ks, pk), cidx, 1)
+                cvb = jax.lax.dynamic_update_index_in_dim(
+                    cvb, jnp.where(valid, vs, pv), cidx, 1)
+            else:
+                out = apply_stage(inp, mask_chunks[cidx])
             recv_next = jax.lax.ppermute(out, "pipe", perm)
             oidx = jnp.clip(t - (S - 1), 0, M - 1)
             live = jnp.logical_and(t >= S - 1, jnp.equal(sid, S - 1))
@@ -627,15 +757,23 @@ class PipelinedBlocks(nn.Module):
                                                 keepdims=False)
             outs = jax.lax.dynamic_update_index_in_dim(
                 outs, jnp.where(live, out, prev), oidx, 0)
-            return (recv_next, outs), None
+            return (recv_next, outs, ckb, cvb), None
 
         outs0 = jnp.zeros((M, cb, L, D), x_local.dtype)
-        (_, outs), _ = jax.lax.scan(
-            tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0),
+        L_loc = jax.tree_util.tree_leaves(lp_local)[0].shape[0]
+        Dh = D // self.num_heads
+        kv0 = (jnp.zeros((L_loc, M, cb, self.num_heads, L, Dh), self.dtype)
+               if collect_kv else jnp.zeros((), x_local.dtype))
+        (_, outs, ckb, cvb), _ = jax.lax.scan(
+            tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0, kv0, kv0),
             jnp.arange(M + S - 1))
         # Outputs live on the last stage; replicate them across the pipe
         # axis with one masked all-reduce.
         outs = jax.lax.psum(
             jnp.where(jnp.equal(jax.lax.axis_index("pipe"), S - 1), outs,
                       jnp.zeros_like(outs)), "pipe")
-        return outs.reshape(B, L, D)
+        outs = outs.reshape(B, L, D)
+        if collect_kv:
+            kvshape = (L_loc, B, self.num_heads, L, D // self.num_heads)
+            return outs, ckb.reshape(kvshape), cvb.reshape(kvshape)
+        return outs

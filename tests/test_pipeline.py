@@ -398,10 +398,13 @@ def test_pipe_without_scan_layers_rejected():
         run_train.main(ns)
 
 
-def test_pipe_mesh_decode_falls_back_to_recompute(tmp_path):
-    """--pipe N --eval_decode must keep working: under a pipe > 1 mesh the
-    sampler falls back to the gpipe full-recompute forward instead of
-    crashing on the (unavailable) cache path."""
+def test_pipe_mesh_decode_uses_cache(tmp_path):
+    """--pipe N --eval_decode generates through the pipe-sharded KV cache
+    (pipeline._decode_pipe: prefill collects per-stage caches inside the
+    GPipe schedule, each token takes S masked ring hops — O(L) per token)
+    and must be BIT-IDENTICAL to the pipe == 1 cache path, on both
+    {data, pipe} and {fsdp, pipe} meshes; a tensor mesh (no TP decode
+    path) falls back to the identical-output full recompute."""
     import numpy as np
 
     from distributed_pipeline_tpu.data import load_data_from_args
@@ -417,8 +420,9 @@ def test_pipe_mesh_decode_falls_back_to_recompute(tmp_path):
         "valid", batch_size=8, dataset="synthetic-lm", seq_len=16,
         vocab_size=64, seed=0, deterministic=True))
     ids = jnp.asarray(batch["input_ids"])
-    ref = gpt2_decode(wl, params, ids, 8)  # no mesh: cache path
-    mesh = make_mesh(dp=2, pipe=4)
-    with mesh:
-        pred = gpt2_decode(wl, params, ids, 8)  # pipe mesh: recompute path
-    np.testing.assert_array_equal(np.asarray(ref), np.asarray(pred))
+    ref = gpt2_decode(wl, params, ids, 8)  # no mesh: pipe == 1 cache path
+    for axes in (dict(dp=2, pipe=4), dict(fsdp=2, pipe=4),
+                 dict(dp=1, tensor=2, pipe=4)):
+        with make_mesh(**axes):
+            pred = gpt2_decode(wl, params, ids, 8)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(pred))
