@@ -1,0 +1,53 @@
+"""Direct A/B timing of flash fwd / fwd+bwd, per-call dispatch timing with
+many repeats (median reported) — sanity harness for kernel changes."""
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pipeline_tpu.ops.flash_attention import flash_attention
+
+
+def med_time(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main():
+    bq = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    bk = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    for (B, H, L, Dh) in [(2, 12, 4096, 64), (2, 12, 8192, 64)]:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (B, H, L, Dh), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, H, L, Dh), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, H, L, Dh), jnp.bfloat16)
+
+        fwd = jax.jit(lambda a, b, c: flash_attention(a, b, c, None, True,
+                                                      bq, bk))
+
+        def loss(a, b, c):
+            return jnp.sum(flash_attention(a, b, c, None, True, bq, bk)
+                           .astype(jnp.float32) ** 2)
+        gr = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        print(json.dumps({
+            "shape": f"B{B}xH{H}xL{L}xD{Dh}", "block": [bq, bk],
+            "fwd_ms": med_time(fwd, q, k, v) * 1e3,
+            "fwdbwd_ms": med_time(gr, q, k, v) * 1e3,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Flash-kernel microbench on the real chip (dispatch-amortized chained
+timing — the axon tunnel costs ~2.7ms/dispatch, so each timed unit is a
+jitted chain of REPS dependent kernel calls).
+
+Usage: python artifacts/flash_microbench.py [fwd|bwd|both] [block_q block_k]
+Writes one JSON line per shape to stdout.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pipeline_tpu.ops.flash_attention import flash_attention
+
+import os
+
+REPS = int(os.environ.get("REPS", "8"))
+
+
+def _drain(out):
+    # device_get forces full completion through the tunnel —
+    # block_until_ready under-blocks on axon
+    float(jax.device_get(jnp.sum(out[0] if isinstance(out, tuple) else out)
+                         .astype(jnp.float32)))
+
+
+def timeit(fn, *args):
+    fn = jax.jit(fn)
+    _drain(fn(*args))  # compile + full drain
+    t0 = time.perf_counter()
+    _drain(fn(*args))
+    t1 = time.perf_counter()
+    return (t1 - t0) / REPS
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "both"
+    bq = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    bk = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    for (B, H, L, Dh) in [(2, 12, 4096, 64), (2, 12, 8192, 64)]:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (B, H, L, Dh), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, H, L, Dh), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, H, L, Dh), jnp.bfloat16)
+
+        def fwd_chain(q, k, v):
+            def body(_, c):
+                return flash_attention(c, k, v, None, True, bq, bk)
+            return jax.lax.fori_loop(0, REPS, body, q)
+
+        def bwd_chain(q, k, v):
+            g = jax.grad(
+                lambda q_, k_, v_: jnp.sum(
+                    flash_attention(q_, k_, v_, None, True, bq, bk)
+                    .astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))
+
+            def body(_, c):
+                dq, dk, dv = g(c, k, v)
+                return (c + 0.0 * dq + 0.0 * dk + 0.0 * dv).astype(c.dtype)
+            return jax.lax.fori_loop(0, REPS, body, q)
+
+        row = {"shape": f"B{B}xH{H}xL{L}xD{Dh}", "block": [bq, bk]}
+        if mode in ("fwd", "both"):
+            row["fwd_ms"] = timeit(fwd_chain, q, k, v) * 1e3
+        if mode in ("bwd", "both"):
+            row["fwdbwd_ms"] = timeit(bwd_chain, q, k, v) * 1e3
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

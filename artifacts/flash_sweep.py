@@ -1,0 +1,32 @@
+import json, sys, time, functools
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from distributed_pipeline_tpu.ops.flash_attention import flash_attention
+
+def drain(out):
+    float(jax.device_get(jnp.sum(out[0] if isinstance(out, tuple) else out).astype(jnp.float32)))
+
+def chain_total(fn_body, reps, *args):
+    @jax.jit
+    def chain(q, k, v):
+        return jax.lax.fori_loop(0, reps, lambda _, c: fn_body(c, k, v), q)
+    drain(chain(*args))
+    t0 = time.perf_counter(); drain(chain(*args)); return time.perf_counter() - t0
+
+bq, bk = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 else (1024, 1024)
+for (B, H, L, Dh) in [(2, 12, 4096, 64), (2, 12, 8192, 64)]:
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, H, L, Dh), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, H, L, Dh), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, H, L, Dh), jnp.bfloat16)
+    fwd_body = lambda c, kk_, vv_: flash_attention(c, kk_, vv_, None, True, bq, bk)
+    g = jax.grad(lambda a,b,c_: jnp.sum(flash_attention(a,b,c_,None,True,bq,bk).astype(jnp.float32)**2), argnums=(0,1,2))
+    def bwd_body(c, kk_, vv_):
+        dq, dk, dv = g(c, kk_, vv_)
+        return (c + 1e-30*dq + 1e-30*dk + 1e-30*dv).astype(c.dtype)
+    for name, body in [("fwd", fwd_body), ("fwdbwd", bwd_body)]:
+        t8 = chain_total(body, 8, q, k, v)
+        t40 = chain_total(body, 40, q, k, v)
+        per = (t40 - t8) / 32 * 1e3
+        print(json.dumps({"shape": f"L{L}", "block": [bq, bk], "kind": name,
+                          "per_call_ms": round(per, 3), "t8": round(t8*1e3,1), "t40": round(t40*1e3,1)}), flush=True)

@@ -7,11 +7,29 @@ XLA path's O(L^2) logits. This is the framework's long-context kernel (the
 reference has no native kernels at all, SURVEY.md §2.1; its GPU equivalent
 would be a fused cuDNN/triton attention).
 
-The backward is the FlashAttention-2 scheme: the forward additionally emits
-the per-row log-sum-exp (LSE), and two backward kernels recompute the
-probability blocks from (q, k, LSE) on the fly — one accumulating dq over key
-blocks, one accumulating dk/dv over query blocks — so training memory is also
-O(L): nothing [L, L]-shaped is ever written to HBM in either direction.
+Grid layout (round 5): the kernels iterate a **compressed step table** fed via
+``pltpu.PrefetchScalarGridSpec`` — a static [n_steps, 5] int32 array of
+``(iq, ik, first, last, diag)`` rows covering only the *live* (query-block,
+key-block) pairs. Under causal masking that skips every block strictly above
+the diagonal entirely: no grid step, no DMA, no predicated no-op — at L=4096
+with 1024-wide blocks, 6 of 16 block pairs vanish from the schedule instead
+of being `pl.when`-skipped after their operands were already copied in.
+``diag`` marks diagonal-straddling blocks so only they pay the iota/compare
+triangle mask; interior blocks run unmasked.
+
+The backward is a **single fused kernel** (FlashAttention-2 math): the forward
+emits the per-row log-sum-exp (LSE), and the backward recomputes each
+probability block from (q, k, LSE) once, then derives all three gradients
+from it — dv += pᵀ·dO, ds = p·(dp − delta), dk += dsᵀ·q, dq_partial = ds·k.
+That is 5 MXU passes per block pair versus 7 for the classic two-kernel
+split (separate dq and dk/dv kernels each recompute s and dp). The grid runs
+column-major so dk/dv accumulate in VMEM scratch across a key-block's column;
+dq cannot accumulate in the same order, so each step writes its dq block to a
+per-key-block f32 partial buffer that XLA masked-sums over the key axis
+afterwards — the dead (above-diagonal) partials are never written and are
+excluded by a static mask, so uninitialized memory never reaches the sum.
+The partial buffer is capped at ~1 GiB: longer sequences run the backward
+as several column passes over sliced k/v, keeping training memory O(L).
 
 Layout choices per the TPU tiling rules (/opt/skills/guides/pallas_guide.md):
 last dim padded to a multiple of 128 lanes, block sizes clamped to multiples
@@ -21,7 +39,9 @@ the MXU via ``preferred_element_type``.
 
 Masking: entries whose score was pushed to ``NEG_INF`` (padded keys, causal
 future) are excluded by an exact ``where``, so fully-masked query rows
-produce true zeros in the forward and zero gradients in the backward.
+produce true zeros in the forward and zero gradients in the backward. When
+there is no pad mask and no key padding, the mask input (and its per-step
+VPU add) is dropped entirely.
 
 On non-TPU backends the kernels run in Pallas interpreter mode, so CPU tests
 exercise the real kernel logic.
@@ -34,6 +54,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # TPU-specific bits are unavailable in some CPU-only wheels
@@ -47,59 +68,124 @@ __all__ = ["flash_attention", "flash_attention_lse"]
 
 NEG_INF = -1e9
 LANES = 128  # TPU lane width: last-dim tiles and stat buffers align to this
+# Cap on the backward's dq partial buffer; beyond it the backward chunks
+# into column passes (tests shrink this to force the multi-pass path).
+DQ_PARTIAL_BUDGET_BYTES = 1 << 30
 
 
-def _masked_scores(q, k, kmask, sm_scale, causal, iq, ik, block_q, block_k):
-    """Score block [bq, bk] in f32 with key-pad and causal masking applied,
-    plus the boolean map of live (unmasked) entries. ``causal`` here means
-    "this block straddles the diagonal": callers dispatch interior blocks
-    (fully below the diagonal) with ``causal=False`` so they skip the
-    iota/compare/where triangle work (_causal_split)."""
+@functools.lru_cache(maxsize=None)
+def _plan_steps(nq: int, nk: int, block_q: int, block_k: int,
+                causal: bool, order: str, col0: int = 0,
+                col1: Optional[int] = None):
+    """Static step table for the compressed grid.
+
+    Returns (steps [n_steps, 6] int32, live [ncols, nq] bool). Each step row
+    is ``(iq, ik_local, first, last, diag, ik_global)``: ``ik_local`` indexes
+    blocks of the (possibly column-sliced) operands the kernel sees,
+    ``ik_global`` is the key block's position in the FULL sequence (the
+    causal iota math needs global column offsets). first/last flag the
+    boundary of the accumulation run the kernel owns: for ``order='row'``
+    (forward) a run is one query-block row (o/l/m accumulate over its live
+    key blocks); for ``order='col'`` (backward) a run is one key-block
+    column (dk/dv accumulate over its live query blocks). ``diag`` marks
+    blocks straddling the causal diagonal — only those apply the triangle
+    mask. ``col0``/``col1`` restrict the table to a half-open range of key
+    columns (the backward's memory-bounded column passes).
+    """
+    if col1 is None:
+        col1 = nk
+
+    def is_live(iq, ik):
+        return (not causal) or (ik * block_k < (iq + 1) * block_q)
+
+    def is_interior(iq, ik):
+        return causal and ((ik + 1) * block_k <= iq * block_q)
+
+    cols = range(col0, col1)
+    steps = []
+    if order == "row":
+        for iq in range(nq):
+            ks = [ik for ik in cols if is_live(iq, ik)]
+            for ik in ks:
+                steps.append((iq, ik - col0, int(ik == ks[0]),
+                              int(ik == ks[-1]),
+                              int(causal and not is_interior(iq, ik)), ik))
+    elif order == "col":
+        for ik in cols:
+            qs = [iq for iq in range(nq) if is_live(iq, ik)]
+            for iq in qs:
+                steps.append((iq, ik - col0, int(iq == qs[0]),
+                              int(iq == qs[-1]),
+                              int(causal and not is_interior(iq, ik)), ik))
+    else:  # pragma: no cover
+        raise ValueError(order)
+    live = np.zeros((col1 - col0, nq), bool)
+    for iq, ikl, *_ in steps:
+        live[ikl, iq] = True
+    return np.asarray(steps, np.int32), live
+
+
+def _scores(q, k, mask_row, sm_scale, apply_causal, iq, ik, block_q, block_k):
+    """Score block [bq, bk] in f32 with key-pad / causal masking applied,
+    plus the boolean map of live (unmasked) entries — or None when nothing
+    is masked (no pad mask, block fully below the diagonal), so callers can
+    skip the exactness ``where``. ``iq``/``ik`` are traced scalars read from
+    the step table."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
-    s = s + (1.0 - kmask.astype(jnp.float32))[None, :] * NEG_INF
-    if causal:
+    if mask_row is not None:
+        s = s + (1.0 - mask_row.astype(jnp.float32))[None, :] * NEG_INF
+    if apply_causal:
         rows = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
     # Real scores are O(10); anything at NEG_INF scale is a masked entry.
-    return s, s > NEG_INF / 2
+    live = (s > NEG_INF / 2) if (mask_row is not None or apply_causal) else None
+    return s, live
 
 
-def _causal_split(causal, iq, ik, block_q, block_k, body):
-    """Run ``body(apply_causal)`` under the right predicate: non-causal
-    kernels run every block unmasked; causal kernels skip blocks strictly
-    ABOVE the diagonal, run blocks strictly BELOW it without the triangle
-    mask (the whole block is live — the per-element iota/compare/where is
-    pure VPU waste there), and only diagonal-straddling blocks pay for the
-    exact mask."""
+def _masked_exp(s, live, shift):
+    """exp(s - shift), exactly zero where masked: without the where, a
+    fully-masked row's p would be the softmax over the RAW scores."""
+    p = jnp.exp(s - shift)
+    return p if live is None else jnp.where(live, p, 0.0)
+
+
+def _diag_dispatch(causal, diag, body):
+    """Run ``body(apply_causal)``: non-causal kernels never mask; causal
+    kernels branch on the step table's diag flag so only diagonal-straddling
+    blocks pay the iota/compare/where triangle work (interior blocks are
+    fully live — the per-element mask is pure VPU waste there; blocks above
+    the diagonal are not in the step table at all)."""
     if not causal:
         body(False)
         return
-    live = ik * block_k < (iq + 1) * block_q
-    interior = (ik + 1) * block_k <= iq * block_q
 
-    @pl.when(interior)
+    @pl.when(diag == 0)
     def _interior():
         body(False)
 
-    @pl.when(jnp.logical_and(live, jnp.logical_not(interior)))
+    @pl.when(diag == 1)
     def _diagonal():
         body(True)
 
 
-def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *,
-                sm_scale: float, causal: bool,
-                block_q: int, block_k: int):
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
+def _fwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
+                block_q: int, block_k: int, has_mask: bool):
+    if has_mask:
+        (mask_ref, q_ref, k_ref, v_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        mask_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    t = pl.program_id(1)
+    iq = steps_ref[t, 0]
+    ik = steps_ref[t, 5]  # global column position (causal iota math)
 
-    @pl.when(ik == 0)
+    @pl.when(steps_ref[t, 2] == 1)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
@@ -109,24 +195,23 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0]                       # [block_q, D]
         k = k_ref[0]                       # [block_k, D]
         v = v_ref[0]                       # [block_k, D]
-        s, live = _masked_scores(q, k, mask_ref[0, 0], sm_scale,
-                                 apply_causal, iq, ik, block_q, block_k)
+        mask_row = mask_ref[0, 0] if has_mask else None
+        s, live = _scores(q, k, mask_row, sm_scale,
+                          apply_causal, iq, ik, block_q, block_k)
         m_prev = m_ref[:, :1]                             # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
-        # Exact zero for masked entries: without the where, a fully-masked
-        # row's p would be exp(s - m_new) = softmax over the RAW scores.
-        p = jnp.where(live, jnp.exp(s - m_new), 0.0)      # [bq, bk]
+        p = _masked_exp(s, live, m_new)                   # [bq, bk]
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
-    _causal_split(causal, iq, ik, block_q, block_k, _compute)
+    _diag_dispatch(causal, steps_ref[t, 4], _compute)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(steps_ref[t, 3] == 1)
     def _finalize():
         # Fully-masked query rows have l == 0 exactly; emit zeros, not NaNs.
         l = l_ref[:, :1]
@@ -135,52 +220,23 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, *,
-                   sm_scale: float, causal: bool,
-                   block_q: int, block_k: int):
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
+def _bwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
+                block_q: int, block_k: int, has_mask: bool):
+    """Fused backward: one probability recompute feeds dv, dk (VMEM scratch
+    accumulation down the key-block's column) AND the step's dq partial
+    (written once, summed over nk outside)."""
+    if has_mask:
+        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        mask_ref = None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    t = pl.program_id(1)
+    iq = steps_ref[t, 0]
+    ik = steps_ref[t, 5]  # global column position (causal iota math)
 
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    def _compute(apply_causal):
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]                                    # [bq, D]
-        s, live = _masked_scores(q, k, mask_ref[0, 0], sm_scale,
-                                 apply_causal, iq, ik, block_q, block_k)
-        lse = lse_ref[0][:, :1]                           # [bq, 1]
-        p = jnp.where(live, jnp.exp(s - lse), 0.0)        # [bq, bk] f32
-        dp = jax.lax.dot_general(                         # dO V^T [bq, bk]
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        delta = delta_ref[0][:, :1]                       # rowsum(dO*O) [bq,1]
-        ds = p * (dp - delta) * sm_scale                  # [bq, bk]
-        acc_ref[:] += jax.lax.dot_general(                # ds K [bq, D]
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    _causal_split(causal, iq, ik, block_q, block_k, _compute)
-
-    @pl.when(ik == nk - 1)
-    def _finalize():
-        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    sm_scale: float, causal: bool,
-                    block_q: int, block_k: int):
-    ik = pl.program_id(1)
-    iq = pl.program_id(2)
-    nq = pl.num_programs(2)
-
-    @pl.when(iq == 0)
+    @pl.when(steps_ref[t, 2] == 1)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -189,26 +245,30 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        do = do_ref[0]
-        s, live = _masked_scores(q, k, mask_ref[0, 0], sm_scale,
-                                 apply_causal, iq, ik, block_q, block_k)
-        lse = lse_ref[0][:, :1]
-        p = jnp.where(live, jnp.exp(s - lse), 0.0)        # [bq, bk] f32
+        do = do_ref[0]                                    # [bq, D]
+        mask_row = mask_ref[0, 0] if has_mask else None
+        s, live = _scores(q, k, mask_row, sm_scale,
+                          apply_causal, iq, ik, block_q, block_k)
+        lse = lse_ref[0][:, :1]                           # [bq, 1]
+        p = _masked_exp(s, live, lse)                     # [bq, bk] f32
         dv_acc[:] += jax.lax.dot_general(                 # p^T dO [bk, D]
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
+        dp = jax.lax.dot_general(                         # dO V^T [bq, bk]
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        delta = delta_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]                       # rowsum(dO*O) [bq,1]
         ds = p * (dp - delta) * sm_scale                  # [bq, bk]
         dk_acc[:] += jax.lax.dot_general(                 # ds^T Q [bk, D]
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        dq_ref[0, 0] = jax.lax.dot_general(               # ds K [bq, D]
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
 
-    _causal_split(causal, iq, ik, block_q, block_k, _compute)
+    _diag_dispatch(causal, steps_ref[t, 4], _compute)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(steps_ref[t, 3] == 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -237,16 +297,21 @@ def _block_sizes(L: int, block_q: int, block_k: int):
 
 def _prep(q, k, v, pad_mask, block_q, block_k):
     """Shared padding/reshape for forward and backward: [B, H, L, Dh] ->
-    [B*H, Lq|Lk, D] plus the 8-sublane key-side mask."""
+    [B*H, Lq|Lk, D] plus the 8-sublane key-side mask. The mask is None when
+    nothing needs key-side masking (no pad mask, no key padding) — the
+    kernels then skip the mask input and its per-step add entirely."""
     B, H, L, Dh = q.shape
-    if pad_mask is None:
-        pad_mask = jnp.ones((B, L), jnp.int32)
     qp = _pad_to(_pad_to(q, 3, LANES), 2, block_q)
     kp = _pad_to(_pad_to(k, 3, LANES), 2, block_k)
     vp = _pad_to(_pad_to(v, 3, LANES), 2, block_k)
-    maskp = _pad_to(pad_mask, 1, block_k)  # padded keys -> 0
     Lq, Lk, D = qp.shape[2], kp.shape[2], qp.shape[3]
-    mask8 = jnp.broadcast_to(maskp[:, None, :], (B, 8, Lk))
+    if pad_mask is None and Lk != L:
+        pad_mask = jnp.ones((B, L), jnp.int32)  # zero-pad keys must mask
+    if pad_mask is not None:
+        maskp = _pad_to(pad_mask, 1, block_k)  # padded keys -> 0
+        mask8 = jnp.broadcast_to(maskp[:, None, :], (B, 8, Lk))
+    else:
+        mask8 = None
     bh = B * H
     return (qp.reshape(bh, Lq, D), kp.reshape(bh, Lk, D),
             vp.reshape(bh, Lk, D), mask8, Lq, Lk, D)
@@ -256,40 +321,89 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _xla_forward(q, k, v, pad_mask, causal):
+    """Dense O(L^2) fallback with the kernels' exact masking semantics,
+    returning (out, lse [B*H, L] f32) — used only on wheels whose pallas
+    has no TPU grid support (pltpu import failed)."""
+    B, H, L, Dh = q.shape
+    s = jnp.einsum("bhld,bhmd->bhlm", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (Dh ** -0.5)
+    if pad_mask is not None:
+        s = s + (1.0 - pad_mask.astype(jnp.float32))[:, None, None, :] \
+            * NEG_INF
+    if causal:
+        tri = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(tri[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhlm,bhmd->bhld",
+                     (p / jnp.maximum(l, 1e-20)).astype(v.dtype), v)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-20)))[..., 0]
+    return out.astype(q.dtype), lse.reshape(B * H, L)
+
+
+def _grid_call(kernel, steps, grid, in_specs, out_specs, out_shape,
+               scratch_shapes, inputs):
+    """pallas_call through a scalar-prefetch grid spec: the step table rides
+    in SMEM ahead of the grid so index maps can route each step's blocks."""
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape,
+        interpret=_interpret())(steps, *inputs)
+
+
 def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    pad_mask: Optional[jnp.ndarray], causal: bool,
                    block_q: int, block_k: int):
     """Returns (out [B, H, L, Dh], lse [B*H, Lq, LANES] f32)."""
     B, H, L, Dh = q.shape
+    if pltpu is None:  # pragma: no cover — CPU wheels without pallas-TPU
+        return _xla_forward(q, k, v, pad_mask, causal)
     sm_scale = Dh ** -0.5  # scale by the REAL head dim; zero-padding Dh
     # leaves q·k unchanged
     block_q, block_k = _block_sizes(L, block_q, block_k)
     qp, kp, vp, mask8, Lq, Lk, D = _prep(q, k, v, pad_mask, block_q, block_k)
+    has_mask = mask8 is not None
     bh = B * H
-    grid = (bh, Lq // block_q, Lk // block_k)
+    steps_np, _ = _plan_steps(Lq // block_q, Lk // block_k,
+                              block_q, block_k, causal, "row")
+    grid = (bh, steps_np.shape[0])
+
+    def _iq(b, t, s):
+        return (b, s[t, 0], 0)
+
+    def _ik(b, t, s):
+        return (b, s[t, 1], 0)
+
+    in_specs = []
+    inputs = []
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 8, block_k),
+                                     lambda b, t, s: (b // H, 0, s[t, 1]),
+                                     memory_space=_VMEM))
+        inputs.append(mask8)
+    in_specs += [
+        pl.BlockSpec((1, block_q, D), _iq, memory_space=_VMEM),
+        pl.BlockSpec((1, block_k, D), _ik, memory_space=_VMEM),
+        pl.BlockSpec((1, block_k, D), _ik, memory_space=_VMEM),
+    ]
+    inputs += [qp, kp, vp]
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 8, block_k),                   # key-side pad mask
-                         lambda b, i, j: (b // H, 0, j),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
-                         memory_space=_VMEM),
-        ],
+        block_q=block_q, block_k=block_k, has_mask=has_mask)
+    out, lse = _grid_call(
+        kernel, jnp.asarray(steps_np), grid, in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
-                         memory_space=_VMEM),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0),
-                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_q, D), _iq, memory_space=_VMEM),
+            pl.BlockSpec((1, block_q, LANES), _iq, memory_space=_VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, Lq, D), q.dtype),
@@ -300,8 +414,7 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             _VMEM((block_q, LANES), jnp.float32),   # running max (replicated)
             _VMEM((block_q, LANES), jnp.float32),   # running normalizer
         ],
-        interpret=_interpret(),
-    )(mask8, qp, kp, vp)
+        inputs=inputs)
     # Compact the lane-replicated LSE to [bh, Lq] — kept as a VJP residual
     # for the whole fwd->bwd lifetime, a 128x-replicated copy would rival
     # the activations themselves in HBM.
@@ -310,79 +423,122 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k,
                     g_lse=None):
-    """Blocked dq/dk/dv — probability blocks recomputed from (q, k, lse);
-    nothing [L, L]-shaped touches HBM (FlashAttention-2 backward).
+    """Fused blocked dq/dk/dv — each probability block is recomputed from
+    (q, k, lse) exactly once and feeds all three gradients; nothing
+    [L, L]-shaped touches HBM (FlashAttention-2 backward, single kernel).
 
     ``g_lse`` (optional, [bh, Lq] f32) is the cotangent of the emitted LSE
     (ring attention differentiates through its cross-hop fold weights):
     d lse_i/d s_ij = p_ij, so the contribution folds into the existing
-    softmax-jacobian term as ds = p*(dp - (delta - g_lse)) — the kernels
-    run unchanged on an adjusted delta."""
+    softmax-jacobian term as ds = p*(dp - (delta - g_lse)) — the kernel
+    runs unchanged on an adjusted delta."""
     B, H, L, Dh = q.shape
+    if pltpu is None:  # pragma: no cover — CPU wheels without pallas-TPU
+        (out_, lse_), vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_forward(q_, k_, v_, pad_mask, causal),
+            q, k, v)
+        gl = (jnp.zeros_like(lse_) if g_lse is None
+              else g_lse[:, :lse_.shape[1]].astype(lse_.dtype))
+        return vjp((g, gl))
     sm_scale = Dh ** -0.5
     block_q, block_k = _block_sizes(L, block_q, block_k)
     qp, kp, vp, mask8, Lq, Lk, D = _prep(q, k, v, pad_mask, block_q, block_k)
+    has_mask = mask8 is not None
     bh = B * H
+    nq, nk = Lq // block_q, Lk // block_k
     gp = _pad_to(_pad_to(g, 3, LANES), 2, block_q).reshape(bh, Lq, D)
     op = _pad_to(_pad_to(o, 3, LANES), 2, block_q).reshape(bh, Lq, D)
     # delta = rowsum(dO * O) (the softmax-jacobian correction); both stats
     # are expanded to lane-replicated [*, Lq, LANES] tiles here, just-in-time
-    # for the kernels (the compact [bh, Lq] form is what persists).
+    # for the kernel (the compact [bh, Lq] form is what persists).
     delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bh, Lq, LANES))
     lse = jnp.broadcast_to(lse[..., None], (bh, Lq, LANES))
 
-    stat_spec = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0),
-                             memory_space=_VMEM)
-    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
-                          memory_space=_VMEM)
-    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
-                          memory_space=_VMEM)
-    mask_spec = pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // H, 0, j),
-                             memory_space=_VMEM)
-    # dkv kernel iterates the grid as (bh, ik, iq): swap the roles of the
-    # last two grid axes in every index map.
-    stat_spec_t = pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0),
-                               memory_space=_VMEM)
-    q_spec_t = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0),
-                            memory_space=_VMEM)
-    k_spec_t = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0),
-                            memory_space=_VMEM)
-    mask_spec_t = pl.BlockSpec((1, 8, block_k), lambda b, j, i: (b // H, 0, j),
-                               memory_space=_VMEM)
+    def _iq(b, t, s):
+        return (b, s[t, 0], 0)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, Lq // block_q, Lk // block_k),
-        in_specs=[mask_spec, q_spec, k_spec, k_spec, q_spec, stat_spec,
-                  stat_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, Lq, D), q.dtype),
-        scratch_shapes=[_VMEM((block_q, D), jnp.float32)],
-        interpret=_interpret(),
-    )(mask8, qp, kp, vp, gp, lse, delta)
+    def _ik(b, t, s):
+        return (b, s[t, 1], 0)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(bh, Lk // block_k, Lq // block_q),
-        in_specs=[mask_spec_t, q_spec_t, k_spec_t, k_spec_t, q_spec_t,
-                  stat_spec_t, stat_spec_t],
-        out_specs=[k_spec_t, k_spec_t],
-        out_shape=[jax.ShapeDtypeStruct((bh, Lk, D), k.dtype),
-                   jax.ShapeDtypeStruct((bh, Lk, D), v.dtype)],
-        scratch_shapes=[_VMEM((block_k, D), jnp.float32),
-                        _VMEM((block_k, D), jnp.float32)],
-        interpret=_interpret(),
-    )(mask8, qp, kp, vp, gp, lse, delta)
+    stat_spec = pl.BlockSpec((1, block_q, LANES), _iq, memory_space=_VMEM)
+    q_spec = pl.BlockSpec((1, block_q, D), _iq, memory_space=_VMEM)
+    k_spec = pl.BlockSpec((1, block_k, D), _ik, memory_space=_VMEM)
+    kernel = functools.partial(
+        _bwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, has_mask=has_mask)
+
+    # dq cannot accumulate in VMEM under the column-major grid (its blocks
+    # revisit non-consecutively), so each step writes an f32 partial that
+    # XLA sums over the pass's key-block axis afterwards. To keep training
+    # memory O(L), the partial buffer is capped at ~1 GiB: when nk column
+    # blocks would exceed it, the backward runs in several column passes
+    # over sliced k/v (dk/dv concatenate; dq partial sums accumulate).
+    per_col = bh * Lq * D * 4
+    cols_per_pass = max(1, min(nk, DQ_PARTIAL_BUDGET_BYTES
+                               // max(per_col, 1)))
+    dq = jnp.zeros((bh, Lq, D), jnp.float32)
+    dks, dvs = [], []
+    for c0 in range(0, nk, cols_per_pass):
+        c1 = min(nk, c0 + cols_per_pass)
+        ncols = c1 - c0
+        steps_np, live_np = _plan_steps(nq, nk, block_q, block_k, causal,
+                                        "col", c0, c1)
+        if steps_np.shape[0] == 0:  # pragma: no cover — defensive
+            dks.append(jnp.zeros((bh, ncols * block_k, D), k.dtype))
+            dvs.append(jnp.zeros((bh, ncols * block_k, D), v.dtype))
+            continue
+        sl = slice(c0 * block_k, c1 * block_k)
+        in_specs = []
+        inputs = []
+        if has_mask:
+            in_specs.append(pl.BlockSpec((1, 8, block_k),
+                                         lambda b, t, s: (b // H, 0, s[t, 1]),
+                                         memory_space=_VMEM))
+            inputs.append(mask8[:, :, sl])
+        in_specs += [q_spec, k_spec, k_spec, q_spec, stat_spec, stat_spec]
+        inputs += [qp, kp[:, sl], vp[:, sl], gp, lse, delta]
+
+        dq_part, dk_c, dv_c = _grid_call(
+            kernel, jnp.asarray(steps_np), (bh, steps_np.shape[0]), in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, t, s: (s[t, 1], b, s[t, 0], 0),
+                             memory_space=_VMEM),
+                k_spec, k_spec,
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((ncols, bh, Lq, D), jnp.float32),
+                jax.ShapeDtypeStruct((bh, ncols * block_k, D), k.dtype),
+                jax.ShapeDtypeStruct((bh, ncols * block_k, D), v.dtype),
+            ],
+            scratch_shapes=[_VMEM((block_k, D), jnp.float32),
+                            _VMEM((block_k, D), jnp.float32)],
+            inputs=inputs)
+
+        # Masked sum over the key-block axis: dead (above-diagonal)
+        # partials were never written — the where keeps their uninitialized
+        # contents (possibly NaN bit patterns) out of the reduction. XLA
+        # fuses the select into the reduce: one pass over the partials.
+        if bool(np.all(live_np)):
+            dq = dq + jnp.sum(dq_part, axis=0)
+        else:
+            live = jnp.asarray(live_np)  # [ncols, nq]
+            part5 = dq_part.reshape(ncols, bh, nq, block_q, D)
+            part5 = jnp.where(live[:, None, :, None, None], part5, 0.0)
+            dq = dq + jnp.sum(part5, axis=0).reshape(bh, Lq, D)
+        dks.append(dk_c)
+        dvs.append(dv_c)
+
+    dk = dks[0] if len(dks) == 1 else jnp.concatenate(dks, axis=1)
+    dv = dvs[0] if len(dvs) == 1 else jnp.concatenate(dvs, axis=1)
 
     def unpad(x):
         return x.reshape(B, H, -1, D)[:, :, :L, :Dh]
 
-    return unpad(dq), unpad(dk), unpad(dv)
+    return unpad(dq.astype(q.dtype)), unpad(dk), unpad(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -394,11 +550,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ops.attention._xla_attention (see tests/test_ops.py) in both directions.
 
     Default 1024x1024 blocks are the measured v5e sweet spot (r4 sweep,
-    gpt2-base shape L=4096 bh=48, dispatch-amortized chained timing:
-    fwd 2.5ms / fwd+bwd 10.3ms vs 3.7/12.6 at the old 512x512 default and
-    6.9/22.3 for the dense XLA path; 2048-wide blocks exceed the 16M
-    scoped-VMEM limit). Short/odd L clamps block sizes to the sequence
-    (rounded to the 8-row sublane tile)."""
+    gpt2-base shape L=4096 bh=48, dispatch-amortized chained timing; 2048-
+    wide blocks exceed the 16M scoped-VMEM limit). Short/odd L clamps block
+    sizes to the sequence (rounded to the 8-row sublane tile)."""
     out, _ = _flash_forward(q, k, v, pad_mask, causal, block_q, block_k)
     return out
 
@@ -446,7 +600,7 @@ def _bwd_lse(causal, block_q, block_k, res, cotangents):
     q, k, v, pad_mask, o, lse = res
     g_out, g_lse = cotangents
     B, H, L, _ = q.shape
-    Lq = lse.shape[1]  # padded query length the kernels iterate over
+    Lq = lse.shape[1]  # padded query length the kernel iterates over
     g_lse_p = jnp.zeros((B * H, Lq), jnp.float32)
     g_lse_p = g_lse_p.at[:, :L].set(
         g_lse.reshape(B * H, L).astype(jnp.float32))
