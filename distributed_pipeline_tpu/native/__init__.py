@@ -64,10 +64,27 @@ def _build() -> bool:
     """Compile the shared library if missing or stale; True on success.
 
     Staleness is mtime-based so editing the .cpp during development
-    rebuilds. The compile lands in a temp file first and is moved into
-    place atomically — concurrent processes (e.g. a ``--nprocs`` dev ring)
-    race benignly. Compiler: ``$CXX`` if set (same knob as the Makefile),
-    else the first of g++/clang++ on PATH."""
+    rebuilds, with a SOURCE-HASH sidecar (``<so>.srchash``) as the semantic
+    tie-breaker: a successful build records the sha256 of its sources, so
+    when a later recompile fails the existing .so is reused only if its
+    recorded hash still matches the current sources (mtime lied — e.g. a
+    fresh checkout touched files). A genuinely semantically-stale library
+    falls back to Python instead of silently breaking the 'identical with
+    or without native' parity contract (r4 advisor), unless
+    ``DPT_NATIVE_ALLOW_STALE=1`` opts in. The compile lands in a temp file
+    first and is moved into place atomically — concurrent processes (e.g.
+    a ``--nprocs`` dev ring) race benignly. Compiler: ``$CXX`` if set
+    (same knob as the Makefile), else the first of g++/clang++ on PATH."""
+    import hashlib
+    import warnings
+
+    def _src_hash() -> str:
+        h = hashlib.sha256()
+        for s in _SRCS:
+            with open(s, "rb") as f:
+                h.update(f.read())
+        return h.hexdigest()
+
     try:
         have_srcs = all(os.path.exists(s) for s in _SRCS)
         if os.path.exists(_SO) and (
@@ -90,6 +107,11 @@ def _build() -> bool:
                     capture_output=True, text=True, timeout=120)
                 if proc.returncode == 0:
                     os.replace(tmp, _SO)
+                    try:
+                        with open(_SO + ".srchash", "w") as f:
+                            f.write(_src_hash())
+                    except OSError:
+                        pass
                     return True
             except (OSError, subprocess.SubprocessError):
                 continue
@@ -97,15 +119,27 @@ def _build() -> bool:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
         if os.path.exists(_SO):
-            # Sources are newer but no compiler produced a fresh build:
-            # a previously working (stale) library beats the ~15x slower
-            # Python fallback. Warn so the developer knows edits to the
-            # .cpp are not live.
-            import warnings
+            # Sources are newer but no compiler produced a fresh build.
+            try:
+                with open(_SO + ".srchash") as f:
+                    same_sources = f.read().strip() == _src_hash()
+            except OSError:
+                same_sources = False
+            if same_sources:
+                return True  # mtime skew only; the .so matches the sources
+            if os.environ.get("DPT_NATIVE_ALLOW_STALE") == "1":
+                warnings.warn(
+                    "distributed_pipeline_tpu.native: recompile failed; "
+                    "DPT_NATIVE_ALLOW_STALE=1 -> using the SEMANTICALLY "
+                    "STALE prebuilt library (sources differ from its "
+                    "recorded build hash)")
+                return True
             warnings.warn(
-                "distributed_pipeline_tpu.native: recompile failed; using the "
-                "STALE prebuilt library (sources are newer than the .so)")
-            return True
+                "distributed_pipeline_tpu.native: recompile failed and the "
+                "prebuilt library does not match the current sources — "
+                "falling back to the Python implementations (set "
+                "DPT_NATIVE_ALLOW_STALE=1 to use the stale .so anyway)")
+            return False
         return False
     except OSError:
         if os.path.exists(_SO):

@@ -33,9 +33,12 @@ as several column passes over sliced k/v, keeping training memory O(L).
 
 Layout choices per the TPU tiling rules (/opt/skills/guides/pallas_guide.md):
 last dim padded to a multiple of 128 lanes, block sizes clamped to multiples
-of the 8-row sublane tile, per-row stats (running max/normalizer, LSE, delta)
-kept as [block_q, 128] lane-replicated tiles, scores accumulated in f32 on
-the MXU via ``preferred_element_type``.
+of the 8-row sublane tile, in-VMEM running stats (max/normalizer) kept as
+[block_q, 128] lane-replicated tiles, scores accumulated in f32 on the MXU
+via ``preferred_element_type``. The HBM-resident per-row stats (LSE, delta)
+are COMPACT [bh, nq, block_q] whenever block_q is lane-aligned — one small
+transpose per block beats writing (and re-reading, once per live step) a
+128x lane-replicated copy; tiny/odd block sizes fall back to replication.
 
 Masking: entries whose score was pushed to ``NEG_INF`` (padded keys, causal
 future) are excluded by an exact ``where``, so fully-masked query rows
@@ -71,6 +74,12 @@ LANES = 128  # TPU lane width: last-dim tiles and stat buffers align to this
 # Cap on the backward's dq partial buffer; beyond it the backward chunks
 # into column passes (tests shrink this to force the multi-pass path).
 DQ_PARTIAL_BUDGET_BYTES = 1 << 30
+# Largest [Lq, D] f32 dq accumulator kept resident in VMEM scratch (the
+# fast path: no HBM partials at all). 2 MiB covers L=4096 at Dh'<=128 —
+# measured the v5e limit: the 4 MiB L=8192 plane pushes the kernel's
+# scoped-VMEM footprint to 19.5M > the 16M cap. Longer sequences fall
+# back to the column-pass partial buffer.
+DQ_SCRATCH_MAX_BYTES = 2 << 20
 
 
 @functools.lru_cache(maxsize=None)
@@ -174,7 +183,8 @@ def _diag_dispatch(causal, diag, body):
 
 
 def _fwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
-                block_q: int, block_k: int, has_mask: bool):
+                block_q: int, block_k: int, has_mask: bool,
+                compact_stats: bool):
     if has_mask:
         (mask_ref, q_ref, k_ref, v_ref,
          o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
@@ -217,24 +227,47 @@ def _fwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
         lse = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-20))
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        if compact_stats:
+            # stats live COMPACT in HBM ([bh, nq, block_q]; the whole
+            # plane is one VMEM-resident block per bh): one small
+            # transpose per row block instead of a 128x lane-replicated
+            # write (and the backward's matching fat reads)
+            lse_ref[0, pl.ds(iq, 1), :] = jnp.transpose(lse, (1, 0))
+        else:
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _bwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
-                block_q: int, block_k: int, has_mask: bool):
+                block_q: int, block_k: int, has_mask: bool,
+                dq_scratch: bool):
     """Fused backward: one probability recompute feeds dv, dk (VMEM scratch
-    accumulation down the key-block's column) AND the step's dq partial
-    (written once, summed over nk outside)."""
+    accumulation down the key-block's column) AND the step's dq
+    contribution. ``dq_scratch=True`` (the fast path) accumulates dq in a
+    VMEM-resident [Lq, D] f32 plane, written out once per bh — no HBM
+    partials; False writes per-step partials summed outside (huge-L
+    fallback)."""
     if has_mask:
         (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *dq_pl) = refs
     else:
         mask_ref = None
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *dq_pl) = refs
     t = pl.program_id(1)
+    n_steps = pl.num_programs(1)
+
+    if dq_scratch:
+        dq_plane = dq_pl[0]
+
+        @pl.when(t == 0)
+        def _zero_plane():
+            dq_plane[:] = jnp.zeros_like(dq_plane)
     iq = steps_ref[t, 0]
     ik = steps_ref[t, 5]  # global column position (causal iota math)
+
+    def _stat_col(ref):
+        """This row block's per-row stat as a [block_q, 1] column."""
+        return ref[0][:, :1]
 
     @pl.when(steps_ref[t, 2] == 1)
     def _init():
@@ -249,7 +282,7 @@ def _bwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
         mask_row = mask_ref[0, 0] if has_mask else None
         s, live = _scores(q, k, mask_row, sm_scale,
                           apply_causal, iq, ik, block_q, block_k)
-        lse = lse_ref[0][:, :1]                           # [bq, 1]
+        lse = _stat_col(lse_ref)                          # [bq, 1]
         p = _masked_exp(s, live, lse)                     # [bq, bk] f32
         dv_acc[:] += jax.lax.dot_general(                 # p^T dO [bk, D]
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -257,14 +290,19 @@ def _bwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
         dp = jax.lax.dot_general(                         # dO V^T [bq, bk]
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        delta = delta_ref[0][:, :1]                       # rowsum(dO*O) [bq,1]
+        delta = _stat_col(delta_ref)                      # rowsum(dO*O) [bq,1]
         ds = p * (dp - delta) * sm_scale                  # [bq, bk]
         dk_acc[:] += jax.lax.dot_general(                 # ds^T Q [bk, D]
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dq_ref[0, 0] = jax.lax.dot_general(               # ds K [bq, D]
+        dq_blk = jax.lax.dot_general(                     # ds K [bq, D]
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+            preferred_element_type=jnp.float32)
+        if dq_scratch:
+            row0 = steps_ref[t, 0] * block_q
+            dq_plane[pl.ds(row0, block_q), :] += dq_blk
+        else:
+            dq_ref[0, 0] = dq_blk.astype(dq_ref.dtype)
 
     _diag_dispatch(causal, steps_ref[t, 4], _compute)
 
@@ -272,6 +310,11 @@ def _bwd_kernel(steps_ref, *refs, sm_scale: float, causal: bool,
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    if dq_scratch:
+        @pl.when(t == n_steps - 1)
+        def _emit_dq():
+            dq_ref[0] = dq_plane[:].astype(dq_ref.dtype)
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
@@ -372,9 +415,11 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qp, kp, vp, mask8, Lq, Lk, D = _prep(q, k, v, pad_mask, block_q, block_k)
     has_mask = mask8 is not None
     bh = B * H
-    steps_np, _ = _plan_steps(Lq // block_q, Lk // block_k,
+    nq = Lq // block_q
+    steps_np, _ = _plan_steps(nq, Lk // block_k,
                               block_q, block_k, causal, "row")
     grid = (bh, steps_np.shape[0])
+    compact = block_q % LANES == 0
 
     def _iq(b, t, s):
         return (b, s[t, 0], 0)
@@ -398,16 +443,22 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, has_mask=has_mask)
+        block_q=block_q, block_k=block_k, has_mask=has_mask,
+        compact_stats=compact)
+    lse_spec = (pl.BlockSpec((1, nq, block_q),
+                             lambda b, t, s: (b, 0, 0), memory_space=_VMEM)
+                if compact else
+                pl.BlockSpec((1, block_q, LANES), _iq, memory_space=_VMEM))
+    lse_shape = ((bh, nq, block_q) if compact else (bh, Lq, LANES))
     out, lse = _grid_call(
         kernel, jnp.asarray(steps_np), grid, in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), _iq, memory_space=_VMEM),
-            pl.BlockSpec((1, block_q, LANES), _iq, memory_space=_VMEM),
+            lse_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, Lq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct(lse_shape, jnp.float32),
         ],
         scratch_shapes=[
             _VMEM((block_q, D), jnp.float32),       # acc
@@ -415,10 +466,12 @@ def _flash_forward(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             _VMEM((block_q, LANES), jnp.float32),   # running normalizer
         ],
         inputs=inputs)
-    # Compact the lane-replicated LSE to [bh, Lq] — kept as a VJP residual
-    # for the whole fwd->bwd lifetime, a 128x-replicated copy would rival
-    # the activations themselves in HBM.
-    return out.reshape(B, H, Lq, D)[:, :, :L, :Dh], lse[:, :, 0]
+    # The LSE persists as a VJP residual for the whole fwd->bwd lifetime in
+    # the COMPACT [bh, Lq] form (when block_q is lane-aligned it is written
+    # compact by the kernel; tiny/odd blocks write the lane-replicated
+    # fallback and compact here).
+    lse = lse.reshape(bh, Lq) if compact else lse[:, :, 0]
+    return out.reshape(B, H, Lq, D)[:, :, :L, :Dh], lse
 
 
 def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k,
@@ -454,6 +507,11 @@ def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k,
     delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
+    # The backward reads stats LANE-REPLICATED ([*, Lq, LANES] blocks): the
+    # compact layout was measured SLOWER here — its per-step dynamic-row
+    # select + lane->sublane transpose (2 per live step) cost more than the
+    # fat reads save (the forward, one transpose per ROW-run, keeps the
+    # compact write).
     delta = jnp.broadcast_to(delta[..., None], (bh, Lq, LANES))
     lse = jnp.broadcast_to(lse[..., None], (bh, Lq, LANES))
 
@@ -466,19 +524,28 @@ def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k,
     stat_spec = pl.BlockSpec((1, block_q, LANES), _iq, memory_space=_VMEM)
     q_spec = pl.BlockSpec((1, block_q, D), _iq, memory_space=_VMEM)
     k_spec = pl.BlockSpec((1, block_k, D), _ik, memory_space=_VMEM)
+
+    # dq blocks revisit non-consecutively under the column-major grid, so
+    # they cannot ride an output block's VMEM residency. Fast path: a
+    # whole-[Lq, D] f32 accumulator plane in VMEM scratch, zeroed per bh
+    # and emitted once — no HBM partials at all (fits to L≈4k at D=128,
+    # see DQ_SCRATCH_MAX_BYTES). Fallback
+    # for longer sequences: each step writes an f32 partial that XLA sums
+    # over the pass's key-block axis afterwards, with the partial buffer
+    # capped at ~1 GiB via several column passes over sliced k/v (dk/dv
+    # concatenate; dq partial sums accumulate) — training memory stays
+    # O(L) either way.
+    use_scratch = Lq * D * 4 <= DQ_SCRATCH_MAX_BYTES
     kernel = functools.partial(
         _bwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, has_mask=has_mask)
-
-    # dq cannot accumulate in VMEM under the column-major grid (its blocks
-    # revisit non-consecutively), so each step writes an f32 partial that
-    # XLA sums over the pass's key-block axis afterwards. To keep training
-    # memory O(L), the partial buffer is capped at ~1 GiB: when nk column
-    # blocks would exceed it, the backward runs in several column passes
-    # over sliced k/v (dk/dv concatenate; dq partial sums accumulate).
+        block_q=block_q, block_k=block_k, has_mask=has_mask,
+        dq_scratch=use_scratch)
     per_col = bh * Lq * D * 4
-    cols_per_pass = max(1, min(nk, DQ_PARTIAL_BUDGET_BYTES
-                               // max(per_col, 1)))
+    if use_scratch:
+        cols_per_pass = nk
+    else:
+        cols_per_pass = max(1, min(nk, DQ_PARTIAL_BUDGET_BYTES
+                                   // max(per_col, 1)))
     dq = jnp.zeros((bh, Lq, D), jnp.float32)
     dks, dvs = [], []
     for c0 in range(0, nk, cols_per_pass):
@@ -501,28 +568,38 @@ def _flash_backward(q, k, v, pad_mask, o, lse, g, causal, block_q, block_k,
         in_specs += [q_spec, k_spec, k_spec, q_spec, stat_spec, stat_spec]
         inputs += [qp, kp[:, sl], vp[:, sl], gp, lse, delta]
 
+        if use_scratch:
+            dq_spec = pl.BlockSpec((1, Lq, D), lambda b, t, s: (b, 0, 0),
+                                   memory_space=_VMEM)
+            dq_shape = jax.ShapeDtypeStruct((bh, Lq, D), q.dtype)
+            scratch = [_VMEM((block_k, D), jnp.float32),
+                       _VMEM((block_k, D), jnp.float32),
+                       _VMEM((Lq, D), jnp.float32)]
+        else:
+            dq_spec = pl.BlockSpec((1, 1, block_q, D),
+                                   lambda b, t, s: (s[t, 1], b, s[t, 0], 0),
+                                   memory_space=_VMEM)
+            dq_shape = jax.ShapeDtypeStruct((ncols, bh, Lq, D), jnp.float32)
+            scratch = [_VMEM((block_k, D), jnp.float32),
+                       _VMEM((block_k, D), jnp.float32)]
         dq_part, dk_c, dv_c = _grid_call(
             kernel, jnp.asarray(steps_np), (bh, steps_np.shape[0]), in_specs,
-            out_specs=[
-                pl.BlockSpec((1, 1, block_q, D),
-                             lambda b, t, s: (s[t, 1], b, s[t, 0], 0),
-                             memory_space=_VMEM),
-                k_spec, k_spec,
-            ],
+            out_specs=[dq_spec, k_spec, k_spec],
             out_shape=[
-                jax.ShapeDtypeStruct((ncols, bh, Lq, D), jnp.float32),
+                dq_shape,
                 jax.ShapeDtypeStruct((bh, ncols * block_k, D), k.dtype),
                 jax.ShapeDtypeStruct((bh, ncols * block_k, D), v.dtype),
             ],
-            scratch_shapes=[_VMEM((block_k, D), jnp.float32),
-                            _VMEM((block_k, D), jnp.float32)],
+            scratch_shapes=scratch,
             inputs=inputs)
 
+        if use_scratch:
+            dq = dq_part  # already the full [bh, Lq, D] accumulator
         # Masked sum over the key-block axis: dead (above-diagonal)
         # partials were never written — the where keeps their uninitialized
         # contents (possibly NaN bit patterns) out of the reduction. XLA
         # fuses the select into the reduce: one pass over the partials.
-        if bool(np.all(live_np)):
+        elif bool(np.all(live_np)):
             dq = dq + jnp.sum(dq_part, axis=0)
         else:
             live = jnp.asarray(live_np)  # [ncols, nq]

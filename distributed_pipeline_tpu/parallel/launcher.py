@@ -128,35 +128,41 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
         os.makedirs(log_dir, exist_ok=True)
         print(f"[launcher] per-worker output -> {log_dir}/worker_N.log")
     procs = []
-    for i in range(nprocs):
-        env = dict(os.environ)
-        if run_timestamp:
-            env["DPT_RUN_TIMESTAMP"] = run_timestamp
-        env.update({
-            AUTORUN_ENV_FLAG: "1",
-            "JAX_COORDINATOR_ADDRESS": coord,
-            "JAX_NUM_PROCESSES": str(nprocs),
-            "JAX_PROCESS_INDEX": str(i),
-            "JAX_PLATFORMS": "cpu",
-            # Disable any site-installed remote-accelerator plugin for
-            # dev-mode CPU workers (a registered plugin may override the
-            # platform selection and grab single-tenant hardware).
-            "PALLAS_AXON_POOL_IPS": "",
-            "XLA_FLAGS": (env_flags := env.get("XLA_FLAGS", ""))
-            + (" " if env_flags else "")
-            + f"--xla_force_host_platform_device_count={devices_per_proc}",
-        })
-        if log_dir:
-            # append: a restarted ring continues the same files (the
-            # attempt boundary is visible from the launcher's own log)
-            f = open(os.path.join(log_dir, f"worker_{i}.log"), "ab")
-            logs.append(f)
-            procs.append(subprocess.Popen(cmd_base, env=env, stdout=f,
-                                          stderr=subprocess.STDOUT))
-        else:
-            procs.append(subprocess.Popen(cmd_base, env=env))
-    codes: List[Optional[int]] = [None] * len(procs)
+    # The spawn loop sits INSIDE the try: if opening worker k's log or its
+    # Popen raises (OSError mid-loop), the finally still closes every
+    # already-opened log and the except path below terminates every
+    # already-spawned worker instead of leaking them (r4 advisor).
+    codes: List[Optional[int]] = []
     try:
+        for i in range(nprocs):
+            env = dict(os.environ)
+            if run_timestamp:
+                env["DPT_RUN_TIMESTAMP"] = run_timestamp
+            env.update({
+                AUTORUN_ENV_FLAG: "1",
+                "JAX_COORDINATOR_ADDRESS": coord,
+                "JAX_NUM_PROCESSES": str(nprocs),
+                "JAX_PROCESS_INDEX": str(i),
+                "JAX_PLATFORMS": "cpu",
+                # Disable any site-installed remote-accelerator plugin for
+                # dev-mode CPU workers (a registered plugin may override the
+                # platform selection and grab single-tenant hardware).
+                "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": (env_flags := env.get("XLA_FLAGS", ""))
+                + (" " if env_flags else "")
+                + f"--xla_force_host_platform_device_count="
+                  f"{devices_per_proc}",
+            })
+            if log_dir:
+                # append: a restarted ring continues the same files (the
+                # attempt boundary is visible from the launcher's own log)
+                f = open(os.path.join(log_dir, f"worker_{i}.log"), "ab")
+                logs.append(f)
+                procs.append(subprocess.Popen(cmd_base, env=env, stdout=f,
+                                              stderr=subprocess.STDOUT))
+            else:
+                procs.append(subprocess.Popen(cmd_base, env=env))
+        codes = [None] * len(procs)
         while any(c is None for c in codes):
             for i, p in enumerate(procs):
                 if codes[i] is None:
@@ -177,9 +183,12 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                             codes[i] = p.wait()
                 break
             time.sleep(max(monitor_interval, 0.02))
-    except KeyboardInterrupt:
+    except BaseException:
+        # KeyboardInterrupt or a spawn-phase failure: nothing supervises
+        # the ring anymore — tear it down rather than leak workers.
         for p in procs:
-            p.terminate()
+            if p.poll() is None:
+                p.terminate()
         raise
     finally:
         for f in logs:

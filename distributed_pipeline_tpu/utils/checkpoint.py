@@ -211,22 +211,33 @@ def prune_checkpoints(directory: str, keep: int) -> List[int]:
     doomed = set(steps[:-keep] if len(steps) > keep else [])
     if not doomed:
         return []
-    pruned = set()
+    # A step counts as pruned only when EVERY one of its dirs (model_ +
+    # companions) deleted; partial failures are reported per step so the
+    # log never claims a step was removed while a restorable model_
+    # remains (r4 advisor).
+    failed = set()
+    touched = set()
     for child, name in children:
         if (name.startswith(("model_", "ema_", "opt_"))
                 and parse_step_from_name(name) in doomed):
+            step = parse_step_from_name(name)
+            touched.add(step)
             try:
                 child.rmtree()
-                pruned.add(parse_step_from_name(name))
             # broad by design: epath's gs:// backends surface failures as
             # tf.errors.OpError / gcsfs HttpError etc., not OSError
             except Exception as e:
                 # Retention is housekeeping: a delete failure (gs://
                 # permissions, concurrent cleanup) must never abort the
                 # training run that just saved successfully.
+                failed.add(step)
                 logger.warn(f"checkpoint retention: could not delete "
                             f"{child}: {e}")
-    return sorted(pruned)
+    if failed:
+        logger.warn(f"checkpoint retention: steps "
+                    f"{sorted(failed)} only PARTIALLY deleted — their "
+                    f"remaining dirs will be retried next retention pass")
+    return sorted(touched - failed)
 
 
 def restore_checkpoint(path: str, abstract_target: Any) -> Any:

@@ -209,3 +209,51 @@ def test_jsonl_dataset_uses_index_and_matches_fallback(tmp_path, monkeypatch):
     for a, b in zip(items_native, (ds2[i] for i in range(7))):
         for k in a:
             np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_stale_so_semantics(tmp_path, monkeypatch):
+    """ADVICE r4: when recompile fails, a prebuilt .so is reused ONLY if its
+    recorded source hash matches the current sources; a semantically stale
+    library falls back to Python (unless DPT_NATIVE_ALLOW_STALE=1)."""
+    import hashlib
+    import time
+    import warnings
+
+    from distributed_pipeline_tpu import native as nat
+
+    src = tmp_path / "fake.cpp"
+    src.write_text("int x;")
+    build = tmp_path / "_build"
+    build.mkdir()
+    so = build / "libfake.so"
+    so.write_bytes(b"\x7fELF fake")
+    monkeypatch.setattr(nat, "_SRCS", [str(src)])
+    monkeypatch.setattr(nat, "_BUILD_DIR", str(build))
+    monkeypatch.setattr(nat, "_SO", str(so))
+    monkeypatch.setenv("CXX", str(tmp_path / "no-such-compiler"))
+
+    def age_so():
+        old = time.time() - 1000
+        os.utime(so, (old, old))  # sources newer -> rebuild attempt
+
+    # (a) hash sidecar matches current sources -> mtime skew only, reuse
+    (build / "libfake.so.srchash").write_text(
+        hashlib.sha256(src.read_bytes()).hexdigest())
+    age_so()
+    assert nat._build() is True
+
+    # (b) sources changed since the recorded build -> Python fallback
+    src.write_text("int y;")
+    age_so()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert nat._build() is False
+    assert any("does not match" in str(x.message) for x in w)
+
+    # (c) explicit opt-in uses the stale library anyway
+    monkeypatch.setenv("DPT_NATIVE_ALLOW_STALE", "1")
+    age_so()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert nat._build() is True
+    assert any("STALE" in str(x.message) for x in w)

@@ -214,11 +214,33 @@ def test_flash_backward_multi_column_pass(monkeypatch, causal):
             argnums=(0, 1, 2))(q, k, v)
 
     ref = grads()
-    # one 16-wide column of f32 partials = bh * Lq * D * 4 bytes; force
-    # cols_per_pass down to 2 (3 passes over nk=6)
+    # disable the VMEM dq-plane fast path, then force cols_per_pass down
+    # to 2 (3 passes over nk=6): one 16-wide column of f32 partials =
+    # bh * Lq * D * 4 bytes
+    monkeypatch.setattr(fa, "DQ_SCRATCH_MAX_BYTES", 0)
     monkeypatch.setattr(fa, "DQ_PARTIAL_BUDGET_BYTES",
                         2 * 2 * 2 * 96 * 128 * 4)
     chunked = grads()
     for a, b in zip(ref, chunked):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_compact_stats_path(causal):
+    """block_q lane-aligned (128) takes the COMPACT HBM stats layout
+    (lse/delta as [bh, nq, block_q]); parity in both directions at L=256
+    pins it in interpreter mode, where the TPU bench shapes can't run."""
+    q, k, v = _rand_qkv(12, L=256, Dh=32)
+    ref = _xla_attention(q, k, v, None, causal)
+    out = flash_attention(q, k, v, None, causal, 128, 128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    gr = jax.grad(lambda *a: (_xla_attention(*a, None, causal) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        lambda *a: (flash_attention(*a, None, causal, 128, 128) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=3e-5)

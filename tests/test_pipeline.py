@@ -328,6 +328,36 @@ def test_pipeline_full_composition_fsdp_tensor_pipe(tmp_path):
     np.testing.assert_allclose(ref[1], got[1], rtol=2e-5)
 
 
+def test_1f1b_eval_forward_only_matches_grad_value(tmp_path):
+    """ADVICE r4: a NON-differentiated pipelined loss (eval callbacks) runs
+    the forward-only stream — its value must equal the combined F+B scan's
+    (same chunk accumulation order), and its lowering must be materially
+    smaller (no stage vjp / grad accumulators / reverse ppermutes)."""
+    wl = stacked_workload("gpt2", pp_schedule="1f1b")
+    batch = next(load_data_from_args("train", batch_size=8,
+                                     dataset="synthetic-lm", seq_len=16,
+                                     vocab_size=64, seed=9))
+    loop = TrainLoop(model=wl, data=iter([batch]), batch_size=8, lr=1e-3,
+                     ema_rate="0.9", learning_steps=10, log_interval=10 ** 6,
+                     save_interval=10 ** 9, mesh=make_mesh(dp=2, pipe=4),
+                     checkpoint_dir=str(tmp_path), seed=5)
+    jb = jax.tree_util.tree_map(jnp.asarray, batch)
+
+    def lf(p):
+        return wl.compute_losses(p, jb, jax.random.PRNGKey(0))["loss"]
+
+    with loop.mesh:
+        v_plain = float(jax.jit(lf)(loop.state.params))
+        v_grad = float(jax.jit(jax.value_and_grad(lf))(loop.state.params)[0])
+        plain_txt = jax.jit(lf).lower(loop.state.params).as_text()
+        grad_txt = jax.jit(jax.value_and_grad(lf)).lower(
+            loop.state.params).as_text()
+    np.testing.assert_allclose(v_plain, v_grad, rtol=1e-6)
+    assert len(plain_txt) < 0.6 * len(grad_txt), (
+        f"eval lowering not materially smaller: {len(plain_txt)} vs "
+        f"{len(grad_txt)} — forward-only path not taken?")
+
+
 def test_gpipe_rejects_unsupported_axes():
     wl = stacked_workload()
     batch = jax.tree_util.tree_map(jnp.asarray, wl.example_batch(8))
