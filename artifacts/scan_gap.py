@@ -1,0 +1,150 @@
+"""Decompose the scan_layers MFU gap (r4 bench: 51.1% scan vs 76.5%
+unrolled on diffuseq-base seq128). Times a 12-layer stack fwd+bwd at the
+bench shape under: python-unrolled layers, lax.scan at several unroll
+factors, and scan with the f32->bf16 weight cast hoisted out of the loop.
+
+Long-chain differenced timing (see flash_sweep.py) on the real chip.
+"""
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pipeline_tpu.models.pipeline import block_fwd
+
+NL, D, H, B, L = 12, 768, 12, 64, 128
+
+
+def drain(out):
+    float(jax.device_get(jnp.sum(jax.tree_util.tree_leaves(out)[0])
+                         .astype(jnp.float32)))
+
+
+def chain_total(step, reps, *args):
+    @jax.jit
+    def chain(x, lp):
+        def body(_, c):
+            return step(c, lp)
+        return jax.lax.fori_loop(0, reps, body, x)
+    drain(chain(*args))
+    t0 = time.perf_counter()
+    drain(chain(*args))
+    return time.perf_counter() - t0
+
+
+def make_params(key):
+    ks = jax.random.split(key, 8)
+    init = lambda k, *s: jax.random.normal(k, s, jnp.float32) * 0.02
+    return {
+        "ln1_scale": jnp.ones((NL, D)), "ln1_bias": jnp.zeros((NL, D)),
+        "qkv": init(ks[0], NL, D, 3, H, D // H),
+        "out": init(ks[1], NL, H, D // H, D),
+        "ln2_scale": jnp.ones((NL, D)), "ln2_bias": jnp.zeros((NL, D)),
+        "wi": init(ks[2], NL, D, 4 * D), "wo": init(ks[3], NL, 4 * D, D),
+    }
+
+
+def fwd_stack_scan(lp, x, unroll):
+    def layer(h, one):
+        return block_fwd(one, h, None, num_heads=H, dtype=jnp.bfloat16,
+                         causal=False, attention_impl="xla"), None
+    out, _ = jax.lax.scan(layer, x, lp, unroll=unroll)
+    return out
+
+
+def fwd_stack_unrolled(lp, x):
+    for i in range(NL):
+        one = jax.tree_util.tree_map(lambda a: a[i], lp)
+        x = block_fwd(one, x, None, num_heads=H, dtype=jnp.bfloat16,
+                      causal=False, attention_impl="xla")
+    return x
+
+
+def main():
+    lp = make_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, L, D), jnp.bfloat16)
+
+    def variants():
+        yield "unrolled", lambda lp_, x_: fwd_stack_unrolled(lp_, x_)
+        for u in (1, 2, 4, 12):
+            yield f"scan-u{u}", functools.partial(
+                lambda lp_, x_, u_: fwd_stack_scan(lp_, x_, u_), u_=u)
+        # hoist the f32->bf16 weight cast out of the scanned body
+        def precast(lp_, x_):
+            lpb = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16), lp_)
+            return fwd_stack_scan(lpb, x_, 1)
+        yield "scan-u1-precast", precast
+
+    for name, f in variants():
+        def step_fwd(c, lp_):
+            return f(lp_, c)
+
+        def step_bwd(c, lp_):
+            g = jax.grad(lambda w, xx: jnp.sum(
+                f(w, xx).astype(jnp.float32) ** 2), argnums=(0, 1))
+            dw, dx = g(lp_, c)
+            leaves = jax.tree_util.tree_leaves(dw)
+            bump = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+            return (c + dx * 0 + bump.astype(c.dtype) * 1e-30).astype(c.dtype)
+
+        row = {"variant": name}
+        for kind, stepf, lo, hi in [("fwd", step_fwd, 16, 80),
+                                    ("fwdbwd", step_bwd, 8, 40)]:
+            margs = []
+            for _ in range(2):
+                t_lo = chain_total(stepf, lo, x, lp)
+                t_hi = chain_total(stepf, hi, x, lp)
+                margs.append((t_hi - t_lo) / (hi - lo) * 1e3)
+            row[kind + "_ms"] = round(min(margs), 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__" and "--policies" not in sys.argv:
+    main()
+
+
+def fwd_stack_scan_policy(lp, x, policy):
+    def layer(h, one):
+        return block_fwd(one, h, None, num_heads=H, dtype=jnp.bfloat16,
+                         causal=False, attention_impl="xla"), None
+    layer = jax.checkpoint(layer, policy=policy, prevent_cse=False)
+    out, _ = jax.lax.scan(layer, x, lp)
+    return out
+
+
+def main_policies():
+    lp = make_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, L, D), jnp.bfloat16)
+    cp = jax.checkpoint_policies
+    for name, pol in [
+        ("remat-full", None),
+        ("dots-no-batch", cp.dots_with_no_batch_dims_saveable),
+        ("dots", cp.dots_saveable),
+    ]:
+        f = functools.partial(fwd_stack_scan_policy, policy=pol)
+
+        def step_bwd(c, lp_):
+            g = jax.grad(lambda w, xx: jnp.sum(
+                f(w, xx).astype(jnp.float32) ** 2), argnums=(0, 1))
+            dw, dx = g(lp_, c)
+            leaves = jax.tree_util.tree_leaves(dw)
+            bump = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+            return (c + dx * 0 + bump.astype(c.dtype) * 1e-30).astype(c.dtype)
+
+        margs = []
+        for _ in range(2):
+            t_lo = chain_total(step_bwd, 8, x, lp)
+            t_hi = chain_total(step_bwd, 40, x, lp)
+            margs.append((t_hi - t_lo) / 32 * 1e3)
+        print(json.dumps({"variant": f"scan-u1-{name}",
+                          "fwdbwd_ms": round(min(margs), 3)}), flush=True)
+
+
+if __name__ == "__main__" and "--policies" in sys.argv:
+    main_policies()

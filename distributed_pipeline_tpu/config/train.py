@@ -92,6 +92,12 @@ class ModelSettings(S):
                                  "fast compiles for deep models)")
     pp_chunks: int = _(4, "GPipe microchunks per per-shard batch "
                           "(pipeline parallelism; bubble = (S-1)/(chunks+S-1))")
+    scan_unroll: int = _(
+        0, "scan_layers unroll factor: 0 auto-unrolls stacks of <= 16 "
+           "layers fully (restores unrolled-graph fusion the scan backward "
+           "loses; ~6x compile time) and keeps longer stacks as true "
+           "scans; N forces a factor (1 or full recommended — partial "
+           "factors measured pathological on TPU)")
     pp_schedule: Literal["1f1b", "gpipe"] = _(
         "1f1b", "pipeline training schedule: 1f1b streams each chunk's "
                 "backward as soon as its forward clears the last stage "

@@ -188,6 +188,7 @@ class TransformerBackbone(nn.Module):
     scan_layers: bool = False  # stacked weights: lax.scan over layers, and
     # GPipe pipeline streaming when the mesh has a pipe axis > 1
     pp_chunks: int = 4
+    scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -203,6 +204,7 @@ class TransformerBackbone(nn.Module):
                     moe_every=self.moe_every,
                     moe_no_drop=self.moe_no_drop, remat=self.remat,
                     attention_impl=self.attention_impl,
+                    scan_unroll=self.scan_unroll,
                     name="blocks")(x, pad_mask, cache_index)
             else:
                 from .pipeline import PipelinedBlocks
@@ -212,6 +214,7 @@ class TransformerBackbone(nn.Module):
                     pp_chunks=self.pp_chunks,
                     attention_impl=self.attention_impl,
                     decode=self.decode,
+                    scan_unroll=self.scan_unroll,
                     name="blocks")(x, pad_mask, cache_index)
             return nn.LayerNorm(dtype=jnp.float32,
                                 name="ln_f")(x).astype(self.dtype)

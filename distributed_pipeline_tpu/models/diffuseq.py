@@ -64,6 +64,7 @@ class DiffuSeqModel(nn.Module):
     scan_layers: bool = False
     pp_chunks: int = 4
     pp_schedule: str = "1f1b"  # training schedule under a pipe > 1 mesh
+    scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
 
     def setup(self) -> None:
         # dim1 is the low-dim diffusion embedding SPACE (emb_dim), not the
@@ -99,6 +100,7 @@ class DiffuSeqModel(nn.Module):
             moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
             moe_every=self.moe_every, moe_no_drop=self.moe_no_drop,
             scan_layers=self.scan_layers, pp_chunks=self.pp_chunks,
+            scan_unroll=self.scan_unroll,
             name="backbone")
         self.out_proj = nn.Dense(
             self.emb_dim, kernel_init=nn.with_logical_partitioning(

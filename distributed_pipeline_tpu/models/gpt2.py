@@ -44,6 +44,7 @@ class GPT2Model(nn.Module):
     scan_layers: bool = False
     pp_chunks: int = 4
     pp_schedule: str = "1f1b"  # training schedule under a pipe > 1 mesh
+    scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
@@ -81,6 +82,7 @@ class GPT2Model(nn.Module):
                                 moe_no_drop=self.moe_no_drop,
                                 scan_layers=self.scan_layers,
                                 pp_chunks=self.pp_chunks,
+                                scan_unroll=self.scan_unroll,
                                 name="backbone")(h, pad_mask, cache_index)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the
         # HBM traffic of f32; softmax stats go to f32 downstream (ops/xent.py).

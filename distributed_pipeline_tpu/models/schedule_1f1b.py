@@ -447,7 +447,8 @@ def _stage_fn_for(model, gather, causal: bool, tp: bool):
     return functools.partial(
         stage_apply, num_heads=model.num_heads, dtype=model.dtype,
         causal=causal, attention_impl=model.attention_impl,
-        remat=model.remat, gather=gather, tp=tp)
+        remat=model.remat, gather=gather, tp=tp,
+        scan_unroll=model.scan_unroll)
 
 
 def _check_pipe_mesh(mesh):

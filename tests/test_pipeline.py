@@ -503,3 +503,25 @@ def test_pipe_mesh_decode_uses_cache(tmp_path):
         with make_mesh(**axes):
             pred = gpt2_decode(wl, params, ids, 8)
         np.testing.assert_array_equal(np.asarray(ref), np.asarray(pred))
+
+
+def test_scan_unroll_invariance(tmp_path):
+    """The scan_unroll knob is perf-only: losses are identical between a
+    true scan (unroll=1) and the auto-unrolled stack, two steps deep."""
+    losses = {}
+    for tag, u in (("u1", 1), ("auto", 0)):
+        wl = create_model_from_config(
+            model_family="diffuseq", vocab_size=64, seq_len=16,
+            hidden_size=32, num_layers=4, num_heads=2, diffusion_steps=50,
+            dtype="float32", scan_layers=True, scan_unroll=u)
+        batch = next(load_data_from_args("train", batch_size=8,
+                                         dataset="synthetic-seq2seq",
+                                         seq_len=16, vocab_size=64, seed=3))
+        loop = TrainLoop(model=wl, data=iter([batch]), batch_size=8,
+                         lr=1e-3, ema_rate="0.9", learning_steps=10,
+                         log_interval=10 ** 6, save_interval=10 ** 9,
+                         mesh=make_mesh(dp=8),
+                         checkpoint_dir=str(tmp_path / tag), seed=5)
+        losses[tag] = (float(loop.run_step(batch)["loss"]),
+                       float(loop.run_step(batch)["loss"]))
+    np.testing.assert_allclose(losses["u1"], losses["auto"], rtol=2e-6)
