@@ -205,6 +205,7 @@ class TransformerBackbone(nn.Module):
                     moe_no_drop=self.moe_no_drop, remat=self.remat,
                     attention_impl=self.attention_impl,
                     scan_unroll=self.scan_unroll,
+                    pp_chunks=self.pp_chunks,
                     name="blocks")(x, pad_mask, cache_index)
             else:
                 from .pipeline import PipelinedBlocks

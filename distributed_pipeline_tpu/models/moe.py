@@ -107,13 +107,23 @@ class MoEMlp(nn.Module):
 def moe_mlp_fwd(mp: Dict[str, jnp.ndarray], x: jnp.ndarray,
                 pad_mask: Optional[jnp.ndarray], *, top_k: int,
                 capacity_factor: float, dtype: jnp.dtype,
-                no_drop: bool = False):
+                no_drop: bool = False, return_stats: bool = False):
     """The MoE MLP as a pure function of its param dict ``{"router":
     [D, E] f32, "wi": [E, D, M], "wo": [E, M, D]}`` — the single
     implementation behind :class:`MoEMlp` (named blocks) AND the stacked
     scan-layers path (pipeline.MoEScanBlocks), which slices per-group
     weights out of a leading layers axis. Returns ``(y, aux_loss,
-    dispatch-or-None)``; the caller owns sowing."""
+    dispatch-or-None)``; the caller owns sowing.
+
+    ``return_stats=True`` returns the RAW load-balance sums instead of the
+    finished aux scalar: ``(F [E], P [E], n)`` with ``F`` the top-1
+    dispatch counts, ``P`` the router-prob sums over live tokens, ``n``
+    the live-token count — so a sharded caller (the pipeline stages,
+    whose batch is a shard_map-local chunk) can psum them over its batch
+    axes and form ``aux = E * sum_e (F/n)(P/n)`` from GLOBAL statistics.
+    Only ``P`` is differentiable (``F``/``n`` come from argmax one-hots
+    and the pad mask); manual-vjp callers seed its cotangent with
+    ``E * F/n^2`` accordingly."""
     B, L, D = x.shape
     E = mp["wi"].shape[0]
     K = min(top_k, E)
@@ -145,9 +155,10 @@ def moe_mlp_fwd(mp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     # (mean router prob of e), over the k=0 assignment — masked means
     # over REAL tokens only.
     n_live = jnp.maximum(live.sum(), 1.0)
-    f = masks[0].sum(axis=(0, 1)) / n_live               # [E]
-    p = (probs * live[..., None]).sum(axis=(0, 1)) / n_live
-    aux = E * jnp.sum(f * p)
+    F_sum = masks[0].sum(axis=(0, 1))                    # [E]
+    P_sum = (probs * live[..., None]).sum(axis=(0, 1))   # [E]
+    aux = ((F_sum, P_sum, n_live) if return_stats
+           else E * jnp.sum(F_sum / n_live * (P_sum / n_live)))
 
     if no_drop:
         # Exact per-token mixture: every expert computed for every

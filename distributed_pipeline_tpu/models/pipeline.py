@@ -40,7 +40,9 @@ Design:
   tensor axis and block_fwd all-reduces the two partial projections —
   ``tp=True``). ``sequence`` > 1 alongside ``pipe`` > 1 is still rejected
   (ring-in-stage is future work); MoE composes with the scan path via
-  :class:`MoEScanBlocks` (group scan) but not with ``pipe`` > 1 yet.
+  :class:`MoEScanBlocks` (group scan) AND with ``pipe`` > 1 on a
+  {data, pipe} mesh (group stages streamed by the MoE GPipe schedule;
+  the 1F1B request falls back to this AD-differentiated stream for MoE).
   KV-cache decode works in stacked mode at ``pipe == 1`` (``decode=True``,
   mirroring backbone.SelfAttention's contract) AND under ``pipe > 1``
   (``_decode_pipe``: the prefill collects pipe-sharded per-stage caches
@@ -212,8 +214,9 @@ class MoEScanBlocks(nn.Module):
     ``expert`` logical dim (-> mesh expert axis) exactly like the
     named-blocks MoEMlp, and the MoE math IS moe_mlp_fwd — the same pure
     function named blocks call, so parity holds by construction (pinned
-    by tests/test_pipeline.py's transplant test). ``pipe > 1`` is
-    rejected (expert dispatch inside pipeline stages is future work) and
+    by tests/test_pipeline.py's transplant test). ``pipe > 1`` streams
+    the G groups as pipeline stages over a {data, pipe} mesh (``_gpipe``
+    below; fsdp/tensor/expert inside MoE stages are future work) and
     there is no KV-cache decode path (sampling falls back to the
     full-recompute forward, models/sampling.py)."""
 
@@ -230,24 +233,19 @@ class MoEScanBlocks(nn.Module):
     remat: bool = False
     attention_impl: str = "auto"
     scan_unroll: int = 0  # layer-scan unroll knob (scan_unroll_for)
+    pp_chunks: int = 4  # GPipe microchunks under a pipe > 1 mesh
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray] = None,
                  cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-        from .moe import EXPERT, moe_mlp_fwd
+        from .moe import EXPERT
 
         if cache_index is not None:
             raise ValueError("MoE scan blocks have no KV-cache decode "
                              "path; sample with use_cache=False")
         from ..parallel.ring import current_mesh
         mesh = current_mesh()
-        if (mesh is not None and mesh.shape.get("pipe", 1) > 1
-                and not self.is_initializing()):
-            raise ValueError(
-                "scan_layers MoE does not compose with pipe > 1 yet "
-                "(expert dispatch inside pipeline stages); drop --pipe or "
-                "--moe_experts")
         Lc, D, H = self.num_layers, self.hidden_size, self.num_heads
         assert D == x.shape[-1], (D, x.shape)
         Dh, M, E = D // H, 4 * D, self.moe_experts
@@ -293,46 +291,131 @@ class MoEScanBlocks(nn.Module):
                 _dense_init(M), (LAYERS, EXPERT, MLP, EMBED)),
             (G, E, M, D), jnp.float32)
 
-        def group(h, xs):
-            dlp, mlp_ = xs
-
-            def dense_layer(h, one):
-                return block_fwd(one, h, pad_mask, num_heads=H,
-                                 dtype=self.dtype, causal=self.causal,
-                                 attention_impl=self.attention_impl), None
-
-            def moe_block(h):
-                h, _ = block_attn(mlp_, h, pad_mask, num_heads=H,
-                                  dtype=self.dtype, causal=self.causal,
-                                  attention_impl=self.attention_impl)
-                hh = _layernorm(h, mlp_["ln2_scale"],
-                                mlp_["ln2_bias"]).astype(self.dtype)
-                y, aux, _ = moe_mlp_fwd(
-                    {"router": mlp_["router"], "wi": mlp_["wi"],
-                     "wo": mlp_["wo"]}, hh, pad_mask,
-                    top_k=self.moe_top_k,
-                    capacity_factor=self.capacity_factor,
-                    dtype=self.dtype, no_drop=self.moe_no_drop)
-                return h + y, aux
-
-            if self.remat:
-                dense_layer = jax.checkpoint(dense_layer, prevent_cse=False)
-                moe_block = jax.checkpoint(moe_block, prevent_cse=False)
-            if nd:
-                h, _ = jax.lax.scan(
-                    dense_layer, h, dlp,
-                    unroll=scan_unroll_for(nd, self.scan_unroll,
-                                           total=self.num_layers))
-            h, aux = moe_block(h)
-            return h, aux
-
-        x, auxs = jax.lax.scan(
-            group, x, (dense_lp, moe_lp),
-            unroll=scan_unroll_for(G, self.scan_unroll,
-                                   total=self.num_layers))
-        self.sow("losses", "moe_aux", jnp.sum(auxs),
+        S = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        lp = {f"dense_{k}": v for k, v in dense_lp.items()}
+        lp.update({f"moe_{k}": v for k, v in moe_lp.items()})
+        if S > 1 and not self.is_initializing():
+            if G % S:
+                raise ValueError(f"MoE group count {G} (num_layers "
+                                 f"{Lc} / moe_every {me}) not divisible "
+                                 f"by pipe axis {S}")
+            x, aux = self._gpipe(mesh, S, lp, x, pad_mask)
+        else:
+            # pipe == 1: the SAME stage function over the whole stack
+            # (impl passed through unclamped — "auto"/"ring" are valid
+            # outside shard_map), aux from its raw stats with no psums
+            x, stats = moe_stage_apply(
+                lp, x, pad_mask, num_heads=H, dtype=self.dtype,
+                causal=self.causal, attention_impl=self.attention_impl,
+                remat=self.remat, moe_top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor,
+                moe_no_drop=self.moe_no_drop,
+                scan_unroll=self.scan_unroll)
+            aux = moe_aux_from_stats(stats, ())
+        self.sow("losses", "moe_aux", aux,
                  init_fn=lambda: jnp.zeros(()), reduce_fn=jnp.add)
         return x
+
+    def _gpipe(self, mesh, S, lp, x, pad_mask):
+        """GPipe streaming of MoE group stages over the pipe axis (forward;
+        reverse-mode AD differentiates through, same contract as
+        PipelinedBlocks._gpipe). Returns ``(out, aux)`` where ``aux`` is
+        the Switch load-balance loss formed from GLOBAL statistics: raw
+        (F, P, n) sums accumulate across chunks in the schedule carry
+        (differentiable — AD owns the whole stream), are psum'd over the
+        data axis after the scan, and the per-stage group terms sum over
+        pipe — so the value (and its router gradient) is identical to a
+        pure-DP run over the same global batch, independent of the
+        chunking. fsdp/tensor/expert/sequence axes are rejected by
+        moe_stacked_specs (v1 composes {data, pipe} only)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        pspec = moe_stacked_specs(mesh, lp)
+        batch_axes = ("data",) if mesh.shape["data"] > 1 else ()
+        B = x.shape[0]
+        n_b = mesh.shape["data"]
+        if B % n_b:
+            raise ValueError(f"global batch {B} not divisible by data "
+                             f"axis {n_b}")
+        M = self.pp_chunks
+        if (B // n_b) % M:
+            raise ValueError(
+                f"per-shard batch {B // n_b} not divisible by pp_chunks "
+                f"{M}")
+        x3 = P(batch_axes or None, None, None)
+        m2 = P(batch_axes or None, None)
+        fn = shard_map(
+            functools.partial(self._moe_schedule, M=M,
+                              batch_axes=batch_axes),
+            mesh=mesh,
+            in_specs=(pspec, x3, m2),
+            out_specs=(x3, P()),
+            check_vma=False)
+        if pad_mask is None:
+            pad_mask = jnp.ones(x.shape[:2], jnp.int32)
+        return fn(lp, x, pad_mask)
+
+    def _moe_schedule(self, lp_local, x_local, mask_local, *, M: int,
+                      batch_axes):
+        """Per-device MoE GPipe schedule body (shard_map)."""
+        S = jax.lax.psum(1, "pipe")
+        sid = jax.lax.axis_index("pipe")
+        B, L, D = x_local.shape
+        cb = B // M
+        chunks = x_local.reshape(M, cb, L, D)
+        mask_chunks = mask_local.reshape(M, cb, L)
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        impl = (self.attention_impl
+                if self.attention_impl in ("xla", "pallas") else "xla")
+
+        def apply_stage(h, mask):
+            return moe_stage_apply(
+                lp_local, h, mask, num_heads=self.num_heads,
+                dtype=self.dtype, causal=self.causal,
+                attention_impl=impl, remat=self.remat,
+                moe_top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor,
+                moe_no_drop=self.moe_no_drop,
+                scan_unroll=self.scan_unroll)
+
+        def tick(carry, t):
+            recv, outs, st_acc = carry
+            cidx = jnp.clip(t - sid, 0, M - 1)
+            valid = jnp.logical_and(t - sid >= 0, t - sid < M)
+            inp = jnp.where(sid == 0, chunks[jnp.clip(t, 0, M - 1)], recv)
+            out, stats = apply_stage(inp, mask_chunks[cidx])
+            st_acc = jax.tree_util.tree_map(
+                lambda acc, s: acc + jnp.where(valid, s, 0.0), st_acc,
+                stats)
+            recv_next = jax.lax.ppermute(out, "pipe", perm)
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            live = jnp.logical_and(t >= S - 1, jnp.equal(sid, S - 1))
+            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
+                                                keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(live, out, prev), oidx, 0)
+            return (recv_next, outs, st_acc), None
+
+        outs0 = jnp.zeros((M, cb, L, D), x_local.dtype)
+        Gl = next(iter(lp_local.values())).shape[0]
+        E = self.moe_experts
+        st0 = (jnp.zeros((Gl, E), jnp.float32),
+               jnp.zeros((Gl, E), jnp.float32),
+               jnp.zeros((), jnp.float32))
+        (_, outs, st_acc), _ = jax.lax.scan(
+            tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0, st0),
+            jnp.arange(M + S - 1))
+        outs = jax.lax.psum(
+            jnp.where(jnp.equal(sid, S - 1), outs,
+                      jnp.zeros_like(outs)), "pipe")
+        # each stage accumulated ITS groups' raw stats over every chunk;
+        # psum over data makes them global, the pipe psum completes the
+        # sum over groups
+        aux = jax.lax.psum(moe_aux_from_stats(st_acc, batch_axes),
+                   "pipe")
+        return outs.reshape(B, L, D), aux
 
 
 def scan_unroll_for(n_steps: int, knob: int = 0,
@@ -439,6 +522,101 @@ def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
     h, kv = jax.lax.scan(layer, h, lp_local,
                          unroll=scan_unroll_for(n_loc, scan_unroll))
     return (h, kv) if return_kv else h
+
+
+def moe_stacked_specs(mesh, lp: Dict[str, jnp.ndarray]):
+    """shard_map PartitionSpecs for stacked MoE group weights under a pipe
+    mesh: ``pipe`` on the groups dim (dim 0) of every leaf. Composition
+    v1 is {data, pipe} only — fsdp/tensor/expert inside MoE stages
+    (ZeRO-3 gathers, Megatron expert TP, all-to-all expert dispatch
+    across shard_map ranks) are rejected loudly rather than silently
+    computed wrong."""
+    from jax.sharding import PartitionSpec as P
+
+    for ax in ("fsdp", "tensor", "expert", "sequence"):
+        if mesh.shape[ax] > 1:
+            raise ValueError(
+                f"MoE x pipe composes with the data axis only (v1); mesh "
+                f"has {ax}={mesh.shape[ax]}")
+    return {k: P(*(["pipe"] + [None] * (v.ndim - 1))) for k, v in lp.items()}
+
+
+def moe_stage_apply(lp_local, h, mask, *, num_heads: int, dtype,
+                    causal: bool, attention_impl: str, remat: bool,
+                    moe_top_k: int, capacity_factor: float,
+                    moe_no_drop: bool, scan_unroll: int = 0):
+    """Apply one MoE GROUP slice to ``h``: ``lp_local`` holds ``dense_*``
+    [Gl, nd, ...] and ``moe_*`` [Gl, ...] stacked weights (the
+    MoEScanBlocks layout; under pipe, this stage's pipe-shard of the
+    groups dim). Returns ``(h, (F [Gl, E], P [Gl, E], n))`` — the RAW
+    per-group load-balance sums over the LOCAL batch (moe_mlp_fwd
+    return_stats contract: only P differentiable). Shared by the pipe==1
+    group scan and the MoE GPipe schedule, so the two paths cannot
+    diverge (the 1F1B request falls back to that AD GPipe stream for
+    MoE — there is no manual-vjp MoE stage_fn). ``attention_impl`` must
+    arrive pre-resolved: shard_map callers clamp "auto"/"ring" to the
+    dense kernel, the pipe==1 path passes its impl through unclamped.
+    The auto-unroll threshold measures THIS CALL's traced depth
+    (Gl * (nd + 1) layers — per stage under pipe, the whole stack at
+    pipe==1)."""
+    from .moe import moe_mlp_fwd
+
+    dense_loc = {k[len("dense_"):]: v for k, v in lp_local.items()
+                 if k.startswith("dense_")}
+    moe_loc = {k[len("moe_"):]: v for k, v in lp_local.items()
+               if k.startswith("moe_")}
+    nd = next(iter(dense_loc.values())).shape[1] if dense_loc else 0
+    Gl = next(iter(moe_loc.values())).shape[0]
+    traced = Gl * (nd + 1)
+
+    def group(h, xs):
+        dlp, mlp_ = xs
+
+        def dense_layer(h, one):
+            return block_fwd(one, h, mask, num_heads=num_heads, dtype=dtype,
+                             causal=causal,
+                             attention_impl=attention_impl), None
+
+        def moe_block(h):
+            h, _ = block_attn(mlp_, h, mask, num_heads=num_heads,
+                              dtype=dtype, causal=causal,
+                              attention_impl=attention_impl)
+            hh = _layernorm(h, mlp_["ln2_scale"],
+                            mlp_["ln2_bias"]).astype(dtype)
+            y, stats, _ = moe_mlp_fwd(
+                {"router": mlp_["router"], "wi": mlp_["wi"],
+                 "wo": mlp_["wo"]}, hh, mask, top_k=moe_top_k,
+                capacity_factor=capacity_factor, dtype=dtype,
+                no_drop=moe_no_drop, return_stats=True)
+            return h + y, stats
+
+        if remat:
+            dense_layer = jax.checkpoint(dense_layer, prevent_cse=False)
+            moe_block = jax.checkpoint(moe_block, prevent_cse=False)
+        if nd:
+            h, _ = jax.lax.scan(
+                dense_layer, h, dlp,
+                unroll=scan_unroll_for(nd, scan_unroll, total=traced))
+        h, stats = moe_block(h)
+        return h, stats
+
+    h, (F, P, n) = jax.lax.scan(
+        group, h, (dense_loc, moe_loc),
+        unroll=scan_unroll_for(Gl, scan_unroll, total=traced))
+    return h, (F, P, n[0])  # n identical per group (same chunk mask)
+
+
+def moe_aux_from_stats(stats, batch_axes):
+    """Switch load-balance loss from raw (possibly chunk-accumulated)
+    stats, GLOBAL over the mesh's batch shards: psum (F, P, n) over
+    ``batch_axes``, then ``E * sum_(g,e) (F/n)(P/n)`` — this stage's
+    groups' contribution (sum over pipe happens once per schedule)."""
+    F, P, n = stats
+    if batch_axes:
+        F, P, n = jax.lax.psum((F, P, n), batch_axes)
+    E = F.shape[-1]
+    n = jnp.maximum(n, 1.0)
+    return E * jnp.sum((F / n) * (P / n))
 
 
 class PipelinedBlocks(nn.Module):

@@ -138,3 +138,38 @@ def test_moe_routing_is_causal_under_capacity():
     alt = wl.model.apply(params, ids2, pad)
     np.testing.assert_allclose(np.asarray(base[:, :j]),
                                np.asarray(alt[:, :j]), rtol=1e-5, atol=1e-5)
+
+
+def test_moe_pipe_loss_invariant_vs_pure_dp(tmp_path):
+    """VERDICT r4 #4 (MoE x pipe): stacked MoE groups streamed as pipeline
+    stages on {data:2, pipe:2} reproduce the pure-DP loss exactly, two
+    steps deep — per-sequence routing/capacity make the chunk split
+    neutral, and the aux loss is formed from chunk-accumulated GLOBAL
+    statistics, so the value and router gradient match a single-microbatch
+    DP run."""
+    import numpy as np
+
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=4, num_heads=2, dtype="float32", scan_layers=True,
+        moe_experts=4, moe_top_k=2, moe_every=2)
+    batch = next(load_data_from_args("train", batch_size=16,
+                                     dataset="synthetic-lm", seq_len=16,
+                                     vocab_size=64, seed=11))
+    losses = {}
+    for tag, axes in (("dp", dict(dp=8)), ("pp", dict(dp=4, pipe=2))):
+        loop = TrainLoop(model=wl, data=iter([batch]), batch_size=16,
+                         lr=1e-3, ema_rate="0.9", learning_steps=10,
+                         log_interval=10 ** 6, save_interval=10 ** 9,
+                         mesh=make_mesh(**axes),
+                         checkpoint_dir=str(tmp_path / tag), seed=5)
+        if tag == "pp":
+            qkv = (loop.state.params["params"]["backbone"]["blocks"]
+                   ["moe_wi"])
+            assert qkv.sharding.spec[0] == "pipe", qkv.sharding.spec
+        losses[tag] = (float(loop.run_step(batch)["loss"]),
+                       float(loop.run_step(batch)["loss"]))
+    np.testing.assert_allclose(losses["dp"][0], losses["pp"][0], rtol=2e-5)
+    np.testing.assert_allclose(losses["dp"][1], losses["pp"][1], rtol=2e-5)
