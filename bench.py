@@ -256,9 +256,16 @@ def main() -> None:
         measure("diffuseq-base-seq128-scan", family="diffuseq", size="base",
                 seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1,
                 scan_layers=True),
-        # KV-cache decode throughput (generation, not training).
+        # KV-cache decode throughput (generation, not training) at two
+        # batch sizes — the pair anchors the batch-scaling curve (decode
+        # is latency-bound per step, so tokens/s should scale near-
+        # linearly with batch until the weight-streaming bandwidth wall).
         measure_decode("gpt2-base-decode128", gen_tokens=128 if on_tpu else 8,
                        batch=bsz(64), seq_len=1024 if on_tpu else 64),
+        measure_decode("gpt2-base-decode128-b8",
+                       gen_tokens=128 if on_tpu else 8,
+                       batch=8 if on_tpu else 2,
+                       seq_len=1024 if on_tpu else 64),
     ]
 
     configs = [c for c in configs if c is not None]  # BENCH_ONLY filter

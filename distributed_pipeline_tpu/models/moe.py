@@ -157,7 +157,11 @@ def moe_mlp_fwd(mp: Dict[str, jnp.ndarray], x: jnp.ndarray,
     n_live = jnp.maximum(live.sum(), 1.0)
     F_sum = masks[0].sum(axis=(0, 1))                    # [E]
     P_sum = (probs * live[..., None]).sum(axis=(0, 1))   # [E]
-    aux = ((F_sum, P_sum, n_live) if return_stats
+    # stats carry the RAW live count — accumulating callers sum counts
+    # across chunks/shards before the aux division, and a per-chunk clamp
+    # would inflate the global denominator for all-pad chunks (the final
+    # max(n, 1) belongs to moe_aux_from_stats, applied once)
+    aux = ((F_sum, P_sum, live.sum()) if return_stats
            else E * jnp.sum(F_sum / n_live * (P_sum / n_live)))
 
     if no_drop:

@@ -47,8 +47,8 @@ Design:
   mirroring backbone.SelfAttention's contract) AND under ``pipe > 1``
   (``_decode_pipe``: the prefill collects pipe-sharded per-stage caches
   inside the GPipe schedule, then each token takes S masked ring hops —
-  O(L) per token); only ``tensor > 1`` decoding falls back to the
-  full-recompute forward.
+  O(L) per token), INCLUDING ``tensor > 1`` (head-sharded caches, psum'd
+  out/mlp projections per token — r5).
 
 The pure-function block forward here is numerically identical to
 backbone.Block (same pre-LN residual structure, f32 layernorm statistics,
@@ -85,6 +85,63 @@ STACKED_AXES = {
 
 __all__ = ["PipelinedBlocks", "MoEScanBlocks", "block_fwd", "block_attn",
            "stage_apply", "stacked_specs"]
+
+
+def _resolve_impl(attention_impl: str) -> str:
+    """Attention impl for code INSIDE a shard_map body: "auto"/"ring"
+    would consult the ambient mesh from a manual-sharding context, so they
+    resolve to the dense kernel there; explicit "pallas"/"xla" choices are
+    honored. (Paths outside shard_map pass their impl through unclamped.)
+    """
+    return attention_impl if attention_impl in ("xla", "pallas") else "xla"
+
+
+def gpipe_stream(x_local, mask_local, M: int, apply_stage, extra0,
+                 extra_update):
+    """The GPipe tick skeleton, shared by the dense and MoE schedules (one
+    copy of the streaming logic — chunk/bubble masking bugs cannot diverge
+    between them): stream the per-device batch as M chunks over the pipe
+    axis; at tick t, stage 0 ingests chunk t while stage s applies
+    ``apply_stage`` to the chunk received from stage s-1 and forwards the
+    result via a non-cyclic ppermute. ``apply_stage(chunk, mask) ->
+    (out, payload)``; ``extra_update(extra, payload, cidx, valid)`` folds
+    each tick's payload into the carried ``extra`` (KV collection, MoE
+    stats — bubble ticks arrive with valid=False). Returns
+    ``(outs [B_local, L, D] — last-stage results psum-replicated over
+    pipe, extra)``."""
+    S = jax.lax.psum(1, "pipe")
+    sid = jax.lax.axis_index("pipe")
+    B, L, D = x_local.shape
+    cb = B // M
+    chunks = x_local.reshape(M, cb, L, D)
+    mask_chunks = mask_local.reshape(M, cb, L)
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        recv, outs, extra = carry
+        cidx = jnp.clip(t - sid, 0, M - 1)
+        valid = jnp.logical_and(t - sid >= 0, t - sid < M)
+        inp = jnp.where(sid == 0, chunks[jnp.clip(t, 0, M - 1)], recv)
+        out, payload = apply_stage(inp, mask_chunks[cidx])
+        extra = extra_update(extra, payload, cidx, valid)
+        recv_next = jax.lax.ppermute(out, "pipe", perm)
+        oidx = jnp.clip(t - (S - 1), 0, M - 1)
+        live = jnp.logical_and(t >= S - 1, jnp.equal(sid, S - 1))
+        prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(live, out, prev), oidx, 0)
+        return (recv_next, outs, extra), None
+
+    outs0 = jnp.zeros((M, cb, L, D), x_local.dtype)
+    (_, outs, extra), _ = jax.lax.scan(
+        tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0, extra0),
+        jnp.arange(M + S - 1))
+    # Outputs live on the last stage; replicate them across the pipe axis
+    # with one masked all-reduce.
+    outs = jax.lax.psum(
+        jnp.where(jnp.equal(sid, S - 1), outs, jnp.zeros_like(outs)),
+        "pipe")
+    return outs.reshape(B, L, D), extra
 
 
 def _layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
@@ -186,20 +243,25 @@ def block_fwd(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
 def block_decode_step(lp: Dict[str, jnp.ndarray], x: jnp.ndarray,
                       ck: jnp.ndarray, cv: jnp.ndarray, idx: jnp.ndarray,
                       live: jnp.ndarray, *, num_heads: int,
-                      dtype: jnp.dtype):
+                      dtype: jnp.dtype, tp=False):
     """Single-token step of one block against its KV cache: write position
     ``idx`` of ``ck``/``cv`` [B, H, Lmax, Dh], attend the one query to the
     live prefix (``live`` [B, Lmax] — causality IS this mask for one query
     row), return (out [B, 1, D], ck, cv). Mirrors
-    backbone.SelfAttention._cached_attention for stacked weights."""
-    h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype)
+    backbone.SelfAttention._cached_attention for stacked weights. ``tp``
+    (Megatron in-stage TP inside a shard_map body): ``lp`` holds H/t
+    heads and M/t mlp columns, the cache is head-sharded alike, and the
+    out/mlp partial projections all-reduce over ``tensor`` (decode has
+    no backward, so the raw-psum "ad" mode is the right one)."""
+    gate, reduce_ = _tp_ops(tp)
+    h = gate(_layernorm(x, lp["ln1_scale"], lp["ln1_bias"]).astype(dtype))
     qkv = jnp.einsum("bld,dthk->tbhlk", h, lp["qkv"].astype(dtype))
     q, k, v = qkv[0], qkv[1], qkv[2]                  # [B, H, 1, Dh]
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, idx, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, idx, 0))
     o = dot_product_attention(q, ck, cv, live, causal=False, impl="xla")
-    x = x + jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype))
-    return _block_mlp(lp, x, dtype), ck, cv
+    x = x + reduce_(jnp.einsum("bhlk,hkd->bld", o, lp["out"].astype(dtype)))
+    return _block_mlp(lp, x, dtype, tp=tp), ck, cv
 
 
 class MoEScanBlocks(nn.Module):
@@ -358,64 +420,36 @@ class MoEScanBlocks(nn.Module):
 
     def _moe_schedule(self, lp_local, x_local, mask_local, *, M: int,
                       batch_axes):
-        """Per-device MoE GPipe schedule body (shard_map)."""
-        S = jax.lax.psum(1, "pipe")
-        sid = jax.lax.axis_index("pipe")
-        B, L, D = x_local.shape
-        cb = B // M
-        chunks = x_local.reshape(M, cb, L, D)
-        mask_chunks = mask_local.reshape(M, cb, L)
-        perm = [(i, i + 1) for i in range(S - 1)]
-
-        impl = (self.attention_impl
-                if self.attention_impl in ("xla", "pallas") else "xla")
-
+        """Per-device MoE GPipe schedule body (shard_map): the shared
+        gpipe_stream skeleton with a stats-accumulation payload."""
         def apply_stage(h, mask):
             return moe_stage_apply(
                 lp_local, h, mask, num_heads=self.num_heads,
                 dtype=self.dtype, causal=self.causal,
-                attention_impl=impl, remat=self.remat,
-                moe_top_k=self.moe_top_k,
+                attention_impl=_resolve_impl(self.attention_impl),
+                remat=self.remat, moe_top_k=self.moe_top_k,
                 capacity_factor=self.capacity_factor,
                 moe_no_drop=self.moe_no_drop,
                 scan_unroll=self.scan_unroll)
 
-        def tick(carry, t):
-            recv, outs, st_acc = carry
-            cidx = jnp.clip(t - sid, 0, M - 1)
-            valid = jnp.logical_and(t - sid >= 0, t - sid < M)
-            inp = jnp.where(sid == 0, chunks[jnp.clip(t, 0, M - 1)], recv)
-            out, stats = apply_stage(inp, mask_chunks[cidx])
-            st_acc = jax.tree_util.tree_map(
+        def accumulate(st_acc, stats, cidx, valid):
+            del cidx
+            return jax.tree_util.tree_map(
                 lambda acc, s: acc + jnp.where(valid, s, 0.0), st_acc,
                 stats)
-            recv_next = jax.lax.ppermute(out, "pipe", perm)
-            oidx = jnp.clip(t - (S - 1), 0, M - 1)
-            live = jnp.logical_and(t >= S - 1, jnp.equal(sid, S - 1))
-            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
-                                                keepdims=False)
-            outs = jax.lax.dynamic_update_index_in_dim(
-                outs, jnp.where(live, out, prev), oidx, 0)
-            return (recv_next, outs, st_acc), None
 
-        outs0 = jnp.zeros((M, cb, L, D), x_local.dtype)
         Gl = next(iter(lp_local.values())).shape[0]
         E = self.moe_experts
         st0 = (jnp.zeros((Gl, E), jnp.float32),
                jnp.zeros((Gl, E), jnp.float32),
                jnp.zeros((), jnp.float32))
-        (_, outs, st_acc), _ = jax.lax.scan(
-            tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0, st0),
-            jnp.arange(M + S - 1))
-        outs = jax.lax.psum(
-            jnp.where(jnp.equal(sid, S - 1), outs,
-                      jnp.zeros_like(outs)), "pipe")
+        outs, st_acc = gpipe_stream(x_local, mask_local, M, apply_stage,
+                                    st0, accumulate)
         # each stage accumulated ITS groups' raw stats over every chunk;
         # psum over data makes them global, the pipe psum completes the
         # sum over groups
-        aux = jax.lax.psum(moe_aux_from_stats(st_acc, batch_axes),
-                   "pipe")
-        return outs.reshape(B, L, D), aux
+        aux = jax.lax.psum(moe_aux_from_stats(st_acc, batch_axes), "pipe")
+        return outs, aux
 
 
 def scan_unroll_for(n_steps: int, knob: int = 0,
@@ -496,7 +530,7 @@ def stage_apply(lp_local, h, mask, *, num_heads: int, dtype, causal: bool,
     ``return_kv=True`` additionally returns this stage's per-layer
     (k, v) stacks [L_loc, B, H, L, Dh] — the pipe-sharded KV-cache
     prefill (``_decode_pipe``)."""
-    impl = attention_impl if attention_impl in ("xla", "pallas") else "xla"
+    impl = _resolve_impl(attention_impl)
     if gather and not remat:
         lp_local = {
             k: (jax.lax.all_gather(v, "fsdp", axis=gather[k], tiled=True)
@@ -634,15 +668,10 @@ class PipelinedBlocks(nn.Module):
     decode: bool = False  # KV-cache generation (scan_layers, pipe == 1)
     scan_unroll: int = 0  # layer-scan unroll knob (scan_unroll_for)
 
-    def _impl(self) -> str:
-        # Inside the GPipe shard_map, "auto"/"ring" would consult the
-        # ambient mesh from a manual-sharding context — resolve them to the
-        # dense kernel there; an explicit "pallas"/"xla" choice is honored.
-        # The pipe == 1 scan path runs OUTSIDE shard_map and passes
-        # self.attention_impl through unclamped, so "auto" still picks
-        # flash at long context / ring under a sequence mesh.
-        return (self.attention_impl
-                if self.attention_impl in ("xla", "pallas") else "xla")
+    # NOTE: the pipe == 1 scan path runs OUTSIDE shard_map and passes
+    # self.attention_impl through unclamped, so "auto" still picks flash
+    # at long context / ring under a sequence mesh; shard_map bodies
+    # resolve via _resolve_impl.
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -671,11 +700,6 @@ class PipelinedBlocks(nn.Module):
         S = mesh.shape.get("pipe", 1) if mesh is not None else 1
         if self.decode and not self.is_initializing():
             if S > 1:
-                if mesh.shape["tensor"] > 1:
-                    raise ValueError(
-                        "KV-cache decode under a pipe mesh does not "
-                        "support tensor > 1; the sampler falls back to "
-                        "the full-recompute forward")
                 return self._decode_pipe(mesh, S, lp, x, pad_mask,
                                          cache_index)
             return self._decode(lp, x, pad_mask, cache_index)
@@ -778,8 +802,10 @@ class PipelinedBlocks(nn.Module):
         keeps only the active stage's result, and a cyclic ``ppermute``
         advances the activation; after S hops the final hidden state is
         broadcast back with one masked psum. O(L) per token instead of
-        the O(L^2) full-recompute fallback. ``tensor > 1`` is rejected by
-        the caller (the decode step has no TP path)."""
+        the O(L^2) full-recompute fallback. Under ``tensor > 1`` the
+        caches are additionally head-sharded (each rank stores its H/t
+        heads) and every decode step all-reduces the out/mlp partial
+        projections (block_decode_step tp mode)."""
         B, L, D = x.shape
 
         if L > 1:  # prefill
@@ -799,11 +825,15 @@ class PipelinedBlocks(nn.Module):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        pspec, gather, _ = stacked_specs(mesh, lp)
+        pspec, gather, tp = stacked_specs(mesh, lp)
+        tp = "ad" if tp else False  # decode has no backward: raw psums
         batch_axes = tuple(a for a in ("data", "fsdp", "expert")
                            if mesh.shape[a] > 1)
         x3 = P(batch_axes or None, None, None)
-        kv5 = P("pipe", batch_axes or None, None, None, None)
+        # the cache is pipe-sharded on its layers dim AND (under TP)
+        # head-sharded on dim 2 — each tensor rank stores only its heads
+        kv5 = P("pipe", batch_axes or None,
+                "tensor" if tp else None, None, None)
         m2 = P(batch_axes or None, None)
         H = self.num_heads
         perm = [(i, (i + 1) % S) for i in range(S)]
@@ -824,7 +854,7 @@ class PipelinedBlocks(nn.Module):
                     one, k_l, v_l = xs
                     out, k_l, v_l = block_decode_step(
                         one, hh, k_l, v_l, idx_, live_l, num_heads=H,
-                        dtype=self.dtype)
+                        dtype=self.dtype, tp=tp)
                     return out, (k_l, v_l)
 
                 h2, (ck2, cv2) = jax.lax.scan(lstep, h, (lp_local, ck_h,
@@ -898,7 +928,8 @@ class PipelinedBlocks(nn.Module):
         x3 = P(batch_axes or None, None, None)
         m2 = P(batch_axes or None, None)
 
-        kv5 = P("pipe", batch_axes or None, None, None, None)
+        kv5 = P("pipe", batch_axes or None,
+                "tensor" if tp else None, None, None)
         fn = shard_map(
             functools.partial(self._schedule, M=M, gather=gather, tp=tp,
                               collect_kv=collect_kv),
@@ -914,9 +945,11 @@ class PipelinedBlocks(nn.Module):
                   gather: Dict[str, int], tp=False,
                   collect_kv: bool = False):
         # tp domain: False | "ad" | "manual" — see _tp_ops
-        """Per-device GPipe schedule; lp_local holds THIS stage's layers
-        (fsdp-sharded weights are all-gathered before use; the transpose of
-        the gather reduce-scatters their grads — ZeRO-3 semantics).
+        """Per-device GPipe schedule (the shared gpipe_stream skeleton
+        with an optional KV-collection payload); lp_local holds THIS
+        stage's layers (fsdp-sharded weights are all-gathered before use;
+        the transpose of the gather reduce-scatters their grads — ZeRO-3
+        semantics).
 
         Gather placement: without remat, the whole stage stack is gathered
         once up front — OUTSIDE the tick scan, one gather for all ticks
@@ -931,68 +964,43 @@ class PipelinedBlocks(nn.Module):
                     if k in gather else v)
                 for k, v in lp_local.items()}
             gather = {}
-        S = jax.lax.psum(1, "pipe")
-        sid = jax.lax.axis_index("pipe")
         B, L, D = x_local.shape
-        cb = B // M
-        chunks = x_local.reshape(M, cb, L, D)
-        mask_chunks = mask_local.reshape(M, cb, L)
-        perm = [(i, i + 1) for i in range(S - 1)]  # stage s -> s+1
 
-        def apply_stage(h, mask, return_kv=False):
-            return stage_apply(lp_local, h, mask, num_heads=self.num_heads,
-                               dtype=self.dtype, causal=self.causal,
-                               attention_impl=self._impl(),
-                               remat=self.remat, gather=gather, tp=tp,
-                               return_kv=return_kv,
-                               scan_unroll=self.scan_unroll)
+        def apply_stage(h, mask):
+            out = stage_apply(lp_local, h, mask, num_heads=self.num_heads,
+                              dtype=self.dtype, causal=self.causal,
+                              attention_impl=_resolve_impl(
+                                  self.attention_impl),
+                              remat=self.remat, gather=gather, tp=tp,
+                              return_kv=collect_kv,
+                              scan_unroll=self.scan_unroll)
+            return out if collect_kv else (out, None)
 
-        def tick(carry, t):
-            recv, outs, ckb, cvb = carry
-            # chunk being processed by THIS stage at tick t is chunk t-sid;
-            # its pad mask is input data (replicated over pipe), no permute.
-            cidx = jnp.clip(t - sid, 0, M - 1)
-            valid = jnp.logical_and(t - sid >= 0, t - sid < M)
-            inp = jnp.where(sid == 0, chunks[jnp.clip(t, 0, M - 1)], recv)
-            if collect_kv:
-                out, (ks, vs) = apply_stage(inp, mask_chunks[cidx],
-                                            return_kv=True)
-                # this stage's layers' K/V for chunk cidx (bubble ticks
-                # keep the previous slot contents)
-                pk = jax.lax.dynamic_index_in_dim(ckb, cidx, 1,
-                                                  keepdims=False)
-                pv = jax.lax.dynamic_index_in_dim(cvb, cidx, 1,
-                                                  keepdims=False)
-                ckb = jax.lax.dynamic_update_index_in_dim(
-                    ckb, jnp.where(valid, ks, pk), cidx, 1)
-                cvb = jax.lax.dynamic_update_index_in_dim(
-                    cvb, jnp.where(valid, vs, pv), cidx, 1)
-            else:
-                out = apply_stage(inp, mask_chunks[cidx])
-            recv_next = jax.lax.ppermute(out, "pipe", perm)
-            oidx = jnp.clip(t - (S - 1), 0, M - 1)
-            live = jnp.logical_and(t >= S - 1, jnp.equal(sid, S - 1))
-            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
-                                                keepdims=False)
-            outs = jax.lax.dynamic_update_index_in_dim(
-                outs, jnp.where(live, out, prev), oidx, 0)
-            return (recv_next, outs, ckb, cvb), None
+        def update_kv(extra, payload, cidx, valid):
+            if not collect_kv:
+                return extra
+            ckb, cvb = extra
+            ks, vs = payload
+            # this stage's layers' K/V for chunk cidx (bubble ticks keep
+            # the previous slot contents)
+            pk = jax.lax.dynamic_index_in_dim(ckb, cidx, 1, keepdims=False)
+            pv = jax.lax.dynamic_index_in_dim(cvb, cidx, 1, keepdims=False)
+            ckb = jax.lax.dynamic_update_index_in_dim(
+                ckb, jnp.where(valid, ks, pk), cidx, 1)
+            cvb = jax.lax.dynamic_update_index_in_dim(
+                cvb, jnp.where(valid, vs, pv), cidx, 1)
+            return ckb, cvb
 
-        outs0 = jnp.zeros((M, cb, L, D), x_local.dtype)
         L_loc = jax.tree_util.tree_leaves(lp_local)[0].shape[0]
         Dh = D // self.num_heads
-        kv0 = (jnp.zeros((L_loc, M, cb, self.num_heads, L, Dh), self.dtype)
+        cb = B // M
+        # under in-stage TP each rank produces/stores only its H/t heads
+        H_loc = lp_local["qkv"].shape[3]
+        kv0 = (jnp.zeros((L_loc, M, cb, H_loc, L, Dh), self.dtype)
                if collect_kv else jnp.zeros((), x_local.dtype))
-        (_, outs, ckb, cvb), _ = jax.lax.scan(
-            tick, (jnp.zeros((cb, L, D), x_local.dtype), outs0, kv0, kv0),
-            jnp.arange(M + S - 1))
-        # Outputs live on the last stage; replicate them across the pipe
-        # axis with one masked all-reduce.
-        outs = jax.lax.psum(
-            jnp.where(jnp.equal(jax.lax.axis_index("pipe"), S - 1), outs,
-                      jnp.zeros_like(outs)), "pipe")
-        outs = outs.reshape(B, L, D)
+        outs, (ckb, cvb) = gpipe_stream(x_local, mask_local, M,
+                                        apply_stage, (kv0, kv0), update_kv)
         if collect_kv:
-            kvshape = (L_loc, B, self.num_heads, L, D // self.num_heads)
+            kvshape = (L_loc, B, H_loc, L, Dh)
             return outs, ckb.reshape(kvshape), cvb.reshape(kvshape)
         return outs

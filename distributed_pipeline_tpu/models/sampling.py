@@ -207,15 +207,6 @@ def gpt2_decode(workload, params, ids: jnp.ndarray,
     is tested against."""
     pick = _next_token_fn(temperature, top_k, top_p, rng)
     if getattr(workload.model, "scan_layers", False):
-        from ..parallel.ring import current_mesh
-        mesh = current_mesh()
-        if (mesh is not None and mesh.shape.get("pipe", 1) > 1
-                and mesh.shape.get("tensor", 1) > 1):
-            # pipe-mesh cached decode (pipeline._decode_pipe: pipe-sharded
-            # caches, S masked ring hops per token) has no TP path yet —
-            # the gpipe full-recompute forward decodes identically, just
-            # O(L^2) per token
-            use_cache = False
         if getattr(workload.model, "moe_experts", 0) > 0:
             # MoEScanBlocks has no KV cache either — same identical-output
             # full-recompute fallback

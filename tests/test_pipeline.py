@@ -479,9 +479,10 @@ def test_pipe_mesh_decode_uses_cache(tmp_path):
     """--pipe N --eval_decode generates through the pipe-sharded KV cache
     (pipeline._decode_pipe: prefill collects per-stage caches inside the
     GPipe schedule, each token takes S masked ring hops — O(L) per token)
-    and must be BIT-IDENTICAL to the pipe == 1 cache path, on both
-    {data, pipe} and {fsdp, pipe} meshes; a tensor mesh (no TP decode
-    path) falls back to the identical-output full recompute."""
+    and must be BIT-IDENTICAL to the pipe == 1 cache path, on
+    {data, pipe}, {fsdp, pipe}, {tensor, pipe} (r5: head-sharded caches +
+    per-token TP psums, no more recompute fallback) and pure-{tensor}
+    (GSPMD cache) meshes."""
     import numpy as np
 
     from distributed_pipeline_tpu.data import load_data_from_args
@@ -499,10 +500,11 @@ def test_pipe_mesh_decode_uses_cache(tmp_path):
     ids = jnp.asarray(batch["input_ids"])
     ref = gpt2_decode(wl, params, ids, 8)  # no mesh: pipe == 1 cache path
     for axes in (dict(dp=2, pipe=4), dict(fsdp=2, pipe=4),
-                 dict(dp=1, tensor=2, pipe=4)):
+                 dict(dp=1, tensor=2, pipe=4), dict(dp=4, tensor=2)):
         with make_mesh(**axes):
             pred = gpt2_decode(wl, params, ids, 8)
-        np.testing.assert_array_equal(np.asarray(ref), np.asarray(pred))
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(pred),
+                                      err_msg=str(axes))
 
 
 def test_scan_unroll_invariance(tmp_path):
