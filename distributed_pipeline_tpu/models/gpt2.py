@@ -101,7 +101,10 @@ def gpt2_losses(model: GPT2Model, params, batch: Dict[str, jnp.ndarray],
     mesh = current_mesh()
     if (mesh is not None and mesh.shape.get("pipe", 1) > 1
             and model.scan_layers and model.moe_experts == 0
+            and mesh.shape.get("sequence", 1) == 1
             and model.pp_schedule == "1f1b"):
+        # (MoE and ring-in-stage pipe runs take the AD GPipe stream below
+        # instead — the 1F1B engine has no MoE/sequence stage path)
         # training under a pipe mesh: the 1F1B streaming schedule computes
         # loss AND grads in one pass (models/schedule_1f1b.py)
         from .schedule_1f1b import gpt2_1f1b_losses

@@ -38,8 +38,11 @@ Design:
   to the shard; fsdp ranks consume distinct batch shards) AND with
   ``tensor`` (Megatron in-stage TP: heads/mlp weight dims shard over the
   tensor axis and block_fwd all-reduces the two partial projections —
-  ``tp=True``). ``sequence`` > 1 alongside ``pipe`` > 1 is still rejected
-  (ring-in-stage is future work); MoE composes with the scan path via
+  ``tp=True``) AND with ``sequence`` (ring-in-stage, r5: stage
+  activations/masks shard the L dim over the sequence axis and every
+  stage's attention runs the in-shard_map ring — impl "ring_shard";
+  training takes the AD GPipe stream, as the 1F1B engine has no
+  sequence stage path); MoE composes with the scan path via
   :class:`MoEScanBlocks` (group scan) AND with ``pipe`` > 1 on a
   {data, pipe} mesh (group stages streamed by the MoE GPipe schedule;
   the 1F1B request falls back to this AD-differentiated stream for MoE).
@@ -91,9 +94,11 @@ def _resolve_impl(attention_impl: str) -> str:
     """Attention impl for code INSIDE a shard_map body: "auto"/"ring"
     would consult the ambient mesh from a manual-sharding context, so they
     resolve to the dense kernel there; explicit "pallas"/"xla" choices are
-    honored. (Paths outside shard_map pass their impl through unclamped.)
-    """
-    return attention_impl if attention_impl in ("xla", "pallas") else "xla"
+    honored, as is "ring_shard" (the schedule requested in-stage ring
+    attention over a live sequence axis). (Paths outside shard_map pass
+    their impl through unclamped.)"""
+    return (attention_impl
+            if attention_impl in ("xla", "pallas", "ring_shard") else "xla")
 
 
 def gpipe_stream(x_local, mask_local, M: int, apply_stage, extra0,
@@ -892,12 +897,17 @@ class PipelinedBlocks(nn.Module):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        if mesh.shape["sequence"] > 1:
+        seq = mesh.shape["sequence"] > 1
+        if seq and collect_kv:
             raise ValueError(
-                f"pipeline parallelism v1 composes with data/fsdp/tensor/"
-                f"expert axes only; mesh has "
-                f"sequence={mesh.shape['sequence']} (ring-in-stage is "
-                f"future work)")
+                "KV-cache decode does not compose with sequence "
+                "parallelism; the sampler falls back to the recompute "
+                "forward")
+        if seq and x.shape[1] % mesh.shape["sequence"]:
+            raise ValueError(
+                f"seq_len {x.shape[1]} not divisible by sequence axis "
+                f"{mesh.shape['sequence']} (ring attention needs equal "
+                f"L shards)")
         if self.num_layers % S:
             raise ValueError(f"num_layers {self.num_layers} not divisible "
                              f"by pipe axis {S}")
@@ -925,14 +935,17 @@ class PipelinedBlocks(nn.Module):
         # distinct batch shards; tensor ranks share one.
         pspec, gather, tp = stacked_specs(mesh, lp)
         tp = "ad" if tp else False  # shard_map AD transposes raw psums
-        x3 = P(batch_axes or None, None, None)
-        m2 = P(batch_axes or None, None)
+        # ring-in-stage: the sequence axis shards the L dim of
+        # activations and masks; each stage's attention rings over it
+        sq = "sequence" if seq else None
+        x3 = P(batch_axes or None, sq, None)
+        m2 = P(batch_axes or None, sq)
 
         kv5 = P("pipe", batch_axes or None,
                 "tensor" if tp else None, None, None)
         fn = shard_map(
             functools.partial(self._schedule, M=M, gather=gather, tp=tp,
-                              collect_kv=collect_kv),
+                              collect_kv=collect_kv, seq=seq),
             mesh=mesh,
             in_specs=(pspec, x3, m2),
             out_specs=(x3, kv5, kv5) if collect_kv else x3,
@@ -943,7 +956,7 @@ class PipelinedBlocks(nn.Module):
 
     def _schedule(self, lp_local, x_local, mask_local, *, M: int,
                   gather: Dict[str, int], tp=False,
-                  collect_kv: bool = False):
+                  collect_kv: bool = False, seq: bool = False):
         # tp domain: False | "ad" | "manual" — see _tp_ops
         """Per-device GPipe schedule (the shared gpipe_stream skeleton
         with an optional KV-collection payload); lp_local holds THIS
@@ -966,11 +979,12 @@ class PipelinedBlocks(nn.Module):
             gather = {}
         B, L, D = x_local.shape
 
+        impl = "ring_shard" if seq else _resolve_impl(self.attention_impl)
+
         def apply_stage(h, mask):
             out = stage_apply(lp_local, h, mask, num_heads=self.num_heads,
                               dtype=self.dtype, causal=self.causal,
-                              attention_impl=_resolve_impl(
-                                  self.attention_impl),
+                              attention_impl=impl,
                               remat=self.remat, gather=gather, tp=tp,
                               return_kv=collect_kv,
                               scan_unroll=self.scan_unroll)

@@ -207,6 +207,13 @@ def gpt2_decode(workload, params, ids: jnp.ndarray,
     is tested against."""
     pick = _next_token_fn(temperature, top_k, top_p, rng)
     if getattr(workload.model, "scan_layers", False):
+        from ..parallel.ring import current_mesh
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+            # sequence-sharded activations cannot thread a single-token
+            # cache step; the recompute forward (ring attention via
+            # "auto") decodes identically
+            use_cache = False
         if getattr(workload.model, "moe_experts", 0) > 0:
             # MoEScanBlocks has no KV cache either — same identical-output
             # full-recompute fallback

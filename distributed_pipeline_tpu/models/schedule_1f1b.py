@@ -454,9 +454,10 @@ def _stage_fn_for(model, gather, causal: bool, tp: bool):
 def _check_pipe_mesh(mesh):
     if mesh.shape["sequence"] > 1:
         raise ValueError(
-            f"pipeline parallelism v1 composes with data/fsdp/tensor/"
-            f"expert axes only; mesh has sequence="
-            f"{mesh.shape['sequence']} (ring-in-stage is future work)")
+            f"the 1F1B engine composes with data/fsdp/tensor/expert axes "
+            f"only; mesh has sequence={mesh.shape['sequence']} — "
+            f"ring-in-stage pipe runs route through the AD GPipe stream "
+            f"(the family losses gate on sequence == 1)")
 
 
 def gpt2_1f1b_losses(model, params, batch) -> Dict[str, jnp.ndarray]:

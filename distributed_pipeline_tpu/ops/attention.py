@@ -99,4 +99,11 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if impl == "ring":
         from ..parallel.ring import ring_attention_sharded
         return ring_attention_sharded(q, k, v, pad_mask, causal)
+    if impl == "ring_shard":
+        # already INSIDE a shard_map body with the "sequence" axis bound
+        # (ring-in-stage: a pipe stage whose activations are sequence-
+        # sharded) — call the per-device ring directly; the "ring" impl's
+        # own shard_map wrapper cannot nest here.
+        from ..parallel.ring import ring_attention
+        return ring_attention(q, k, v, pad_mask, causal)
     raise ValueError(f"unknown attention impl: {impl}")
