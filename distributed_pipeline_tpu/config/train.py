@@ -98,13 +98,21 @@ class ModelSettings(S):
            "loses; ~6x compile time) and keeps longer stacks as true "
            "scans; N forces a factor (1 or full recommended — partial "
            "factors measured pathological on TPU)")
-    pp_schedule: Literal["1f1b", "gpipe"] = _(
+    pp_schedule: Literal["1f1b", "gpipe", "interleaved"] = _(
         "1f1b", "pipeline training schedule: 1f1b streams each chunk's "
                 "backward as soon as its forward clears the last stage "
                 "(peak stash <= 2S-1 chunks, so pp_chunks can grow to "
-                "shrink the bubble); gpipe differentiates through the "
-                "forward-only schedule (simpler, but activation residuals "
-                "scale with pp_chunks)")
+                "shrink the bubble); interleaved additionally splits each "
+                "device into pp_virtual non-contiguous stage slices, "
+                "cutting the bubble ~Vx at the cost of V*min(M,3S) "
+                "stashed chunks and a per-step weight permute; gpipe "
+                "differentiates through the forward-only schedule "
+                "(simpler, but activation residuals scale with pp_chunks)")
+    pp_virtual: int = _(
+        2, "virtual stage slices per device under "
+           "--pp_schedule interleaved (bubble ~ (S-1)/(V*M+S-1); "
+           "num_layers must divide by pipe * pp_virtual, pp_chunks by "
+           "pipe)")
 
 
 class MeshSettings(S):

@@ -115,7 +115,7 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                              moe_experts: int = 0, moe_top_k: int = 2,
                              moe_every: int = 2, scan_layers: bool = False,
                              pp_chunks: int = 4, pp_schedule: str = "1f1b",
-                             scan_unroll: int = 0,
+                             pp_virtual: int = 2, scan_unroll: int = 0,
                              **_unused: Any) -> Workload:
     """Build a :class:`Workload` from (a superset of) ``TrainSettings`` fields
     — callable as ``create_model_from_config(**settings.dict())`` exactly like
@@ -147,7 +147,7 @@ def create_model_from_config(*, model_family: str = "diffuseq",
             moe_experts=moe_experts, moe_top_k=moe_top_k,
             moe_every=moe_every, scan_layers=scan_layers,
             pp_chunks=pp_chunks, pp_schedule=pp_schedule,
-            scan_unroll=scan_unroll)
+            pp_virtual=pp_virtual, scan_unroll=scan_unroll)
         schedule = make_schedule(noise_schedule, diffusion_steps)
 
         def compute_losses(params, batch, rng):
@@ -166,7 +166,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
             attention_impl=attention_impl, moe_experts=moe_experts,
             moe_top_k=moe_top_k, moe_every=moe_every,
             scan_layers=scan_layers, pp_chunks=pp_chunks,
-            pp_schedule=pp_schedule, scan_unroll=scan_unroll)
+            pp_schedule=pp_schedule, pp_virtual=pp_virtual,
+            scan_unroll=scan_unroll)
 
         def compute_losses(params, batch, rng):
             return gpt2_losses(model, params, batch, rng)

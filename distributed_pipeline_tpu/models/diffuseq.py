@@ -64,6 +64,7 @@ class DiffuSeqModel(nn.Module):
     scan_layers: bool = False
     pp_chunks: int = 4
     pp_schedule: str = "1f1b"  # training schedule under a pipe > 1 mesh
+    pp_virtual: int = 2  # virtual stages/device (pp_schedule="interleaved")
     scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
 
     def setup(self) -> None:
@@ -157,7 +158,7 @@ def diffuseq_losses(model: DiffuSeqModel, schedule: DiffusionSchedule,
     if (mesh is not None and mesh.shape.get("pipe", 1) > 1
             and model.scan_layers and model.moe_experts == 0
             and mesh.shape.get("sequence", 1) == 1
-            and model.pp_schedule == "1f1b"):
+            and model.pp_schedule in ("1f1b", "interleaved")):
         # (MoE and ring-in-stage pipe runs take the AD GPipe stream below
         # instead — the 1F1B engine has no MoE/sequence stage path)
         # training under a pipe mesh: the 1F1B streaming schedule computes

@@ -41,9 +41,22 @@ inside its scan body, so ``jax.vjp(stage_fn)`` emits the matching
 reduce-scatter (``psum_scatter``) and weight grads come out fsdp-sharded
 with no extra plumbing.
 
+**Interleaved (virtual-stage) schedule** (``pp_schedule="interleaved"``,
+r5): the same engine generalized over V slices per device — the model
+splits into S*V virtual stages, stage ``j*S + s`` on device ``s``, so
+activations/cotangents hop devices CYCLICALLY once per slot and each slot
+runs one F and one B sub-slot of 1/V stage depth. Devices enter steady
+state after S-1 slots of 1/V size instead of S-1 full-stage ticks:
+bubble fraction ~(S-1)/(V*M + S-1). Costs: the stash grows to
+V*min(M, 3S) chunk inputs (stash_size), the stacked weights take a
+per-step virtual-stage permute (one weights-sized cross-shard collective,
+whose AD transpose un-permutes the grads), and pp_chunks must divide by
+S. The slot indexing is closed-form (_slot_indices) and reduces EXACTLY
+to the plain schedule at V == 1 — one engine, both schedules.
+
 No reference counterpart (the reference is DDP-only, SURVEY.md §2.2); the
-spec is the 1F1B schedule of the PipeDream/Megatron literature, restated
-for SPMD + XLA collectives.
+spec is the 1F1B/interleaved schedule of the PipeDream/Megatron
+literature, restated for SPMD + XLA collectives.
 """
 
 from __future__ import annotations
@@ -60,11 +73,21 @@ __all__ = ["pipelined_loss", "stash_size", "gpt2_1f1b_losses",
            "diffuseq_1f1b_losses"]
 
 
-def stash_size(M: int, S: int) -> int:
-    """Ring-buffer slots needed for stage-input stashes: the largest
-    forward-to-backward distance in the lockstep schedule is 2(S-1) chunks
-    (stage 0), +1 for the chunk entering this tick — capped at M."""
-    return min(M, 2 * S - 1)
+def stash_size(M: int, S: int, V: int = 1) -> int:
+    """Ring-buffer slots needed PER VIRTUAL SLICE for stage-input stashes.
+
+    V == 1 (plain 1F1B): the largest forward-to-backward distance in the
+    lockstep schedule is 2(S-1) chunks (stage 0), +1 for the chunk
+    entering this tick — capped at M.
+
+    V > 1 (interleaved): virtual stage k's F->B slot distance is
+    2(SV-1-k), and its chunks arrive in bursts of S per SV slots, so the
+    ids in flight at one slice span < 3S — the ring needs min(M, 3S)
+    slots per slice (total stash V*min(M, 3S) chunk inputs: interleaving
+    trades some activation memory for the V-fold bubble reduction)."""
+    if V <= 1:
+        return min(M, 2 * S - 1)
+    return min(M, 3 * S)
 
 
 @jax.custom_vjp
@@ -104,7 +127,7 @@ def _take(tree, i):
 def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
                    stage_fn: Callable, pre_fn: Callable, mask_fn: Callable,
                    head_fn: Callable, lp_specs: Dict[str, Any],
-                   rest_specs=None):
+                   rest_specs=None, pp_virtual: int = 1):
     """Run the 1F1B schedule; returns ``(loss, metrics)``, differentiable
     w.r.t. ``lp`` (stage weights), ``rest`` (embedding/head weights) and
     ``diff`` (differentiable per-sample data, e.g. DiffuSeq's x_t/x_start).
@@ -147,8 +170,13 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
 
     S = mesh.shape["pipe"]
     M = pp_chunks
+    V = max(pp_virtual, 1)
     if S < 2:
         raise ValueError(f"1f1b schedule needs a pipe axis > 1, got {S}")
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved 1F1B groups chunks in bursts of S: pp_chunks "
+            f"{M} must divide by the pipe axis {S}")
     batch_axes = tuple(a for a in ("data", "fsdp", "expert")
                        if mesh.shape[a] > 1)
     n_b = 1
@@ -161,8 +189,8 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
     if (B // n_b) % M:
         raise ValueError(f"per-shard batch {B // n_b} not divisible by "
                          f"pp_chunks {M}")
-    K = stash_size(M, S)
-    T = M + 2 * (S - 1)
+    K = stash_size(M, S, V)
+    T = M * V + S * V + S - 2  # == M + 2(S-1) at V == 1
 
     bspec = P(batch_axes or None)
     rep = P()
@@ -178,7 +206,7 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
                  if a not in tuple(spec))
         for k, spec in lp_specs.items()}
     body = functools.partial(
-        _schedule_body, S=S, M=M, K=K, T=T, stage_fn=stage_fn,
+        _schedule_body, S=S, M=M, K=K, T=T, V=V, stage_fn=stage_fn,
         pre_fn=pre_fn, mask_fn=mask_fn, head_fn=head_fn,
         lp_reduce=lp_reduce)
 
@@ -190,7 +218,7 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
         out_specs=(rep, rep, lp_specs, rest_specs, bspec),
         check_vma=False)
     fwd_only = shard_map(
-        functools.partial(_forward_body, S=S, M=M, stage_fn=stage_fn,
+        functools.partial(_forward_body, S=S, M=M, V=V, stage_fn=stage_fn,
                           pre_fn=pre_fn, mask_fn=mask_fn, head_fn=head_fn),
         mesh=mesh,
         in_specs=(lp_specs, rest_specs, bspec, bspec, rep),
@@ -224,15 +252,63 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
     return run(lp, rest, diff)
 
 
+
+def _slot_indices(t, sid, S, M, V):
+    """Closed-form lockstep slot schedule, generalized over V virtual
+    stages per device (Megatron's interleaved 1F1B restated for SPMD):
+    virtual stage ``k = j*S + s`` lives on device ``s``; chunk ``c``'s F
+    hits it at slot ``u = s + (c//S)*SV + j*S + (c%S)`` (bursts of S
+    chunks per SV slots), and its B mirrors at
+    ``u_b = u_f(SV-1, c) + (SV-1-k)`` — cotangents hop one virtual stage
+    (one device, cyclically) per slot. Inverting both for a given
+    (t, sid) yields the unique active F slice ``jf``/chunk ``cf`` and B
+    slice ``jb``/chunk ``cb`` (unique because {z + j*S mod SV} meets
+    [0, S) exactly once). At V == 1 this reduces EXACTLY to the plain
+    engine: jf = jb = 0, cf = t - sid, cb = t - 2(S-1) + sid, so one
+    engine serves both schedules.
+
+    Returns (jf, cf, vf, jb, cb, vb) — slices, clipped-safe chunk ids
+    (callers clip), and validity masks."""
+    SV = S * V
+    xf = t - sid
+    qf = jnp.mod(xf, SV)
+    jf = qf // S
+    cf = (xf // SV) * S + jnp.mod(qf, S)
+    vf = jnp.logical_and(xf >= 0,
+                         jnp.logical_and(cf >= 0, cf < M))
+    y0 = t + sid + 2 - 2 * SV
+    z = jnp.mod(y0, SV)
+    jb = jnp.mod(-(z // S), V)
+    y = y0 + jb * S
+    cb = (y // SV) * S + jnp.mod(y, SV)
+    vb = jnp.logical_and(y >= 0,
+                         jnp.logical_and(cb >= 0, cb < M))
+    return jf, cf, vf, jb, cb, vb
+
+
+def _slice_lp(lp_local, V, j):
+    """Virtual slice j of this device's stacked weights: [V*per, ...]
+    leaves viewed as [V, per, ...] and dynamically indexed (V == 1 is a
+    no-op reshape of the whole stack)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(
+            a.reshape((V, a.shape[0] // V) + a.shape[1:]), j, 0,
+            keepdims=False),
+        lp_local)
+
+
 def _forward_body(lp_local, rest, diff_local, aux_local, scalars, *,
-                  S, M, stage_fn, pre_fn, mask_fn, head_fn):
+                  S, M, V, stage_fn, pre_fn, mask_fn, head_fn):
     """Forward-only streaming pass over the pipe axis: F slots + loss head,
     no stash, no vjp, no grad accumulators — the eval-time schedule
-    (M + S - 1 ticks). Loss/metric chunk sums accumulate in the same chunk
-    order as the F+B scan, so values match it exactly."""
+    (M*V + S - 1 slots; M + S - 1 at V == 1). Loss/metric chunk sums
+    accumulate in the same chunk order as the F+B scan, so values match
+    it exactly."""
     sid = jax.lax.axis_index("pipe")
-    last = S - 1
-    perm_f = [(i, i + 1) for i in range(S - 1)]
+    # V == 1 never reads the wrapped value (stage 0 takes pre_fn), so the
+    # plain schedule keeps the cheaper non-cyclic shift
+    perm_f = ([(i, (i + 1) % S) for i in range(S)] if V > 1
+              else [(i, i + 1) for i in range(S - 1)])
 
     chunk = lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:])
     diff_c = jax.tree_util.tree_map(chunk, diff_local)
@@ -244,45 +320,61 @@ def _forward_body(lp_local, rest, diff_local, aux_local, scalars, *,
 
     def tick(carry, t):
         recv_f, loss, metrics = carry
-        f = t - sid
-        fc = jnp.clip(f, 0, M - 1)
-        vf = jnp.logical_and(f >= 0, f < M)
+        jf, cf, vf, _, _, _ = _slot_indices(t, sid, S, M, V)
+        fc = jnp.clip(cf, 0, M - 1)
+        kf_first = jnp.logical_and(jnp.equal(sid, 0), jnp.equal(jf, 0))
+        kf_last = jnp.logical_and(jnp.equal(sid, S - 1),
+                                  jnp.equal(jf, V - 1))
         dfc, afc = _take(diff_c, fc), _take(aux_c, fc)
         h0_f = jax.lax.cond(
-            jnp.equal(sid, 0),
+            kf_first,
             lambda ops: pre_fn(ops[0], ops[1], ops[2], scalars),
             lambda ops: zeros_h,
             (rest, dfc, afc))
-        h_in = jnp.where(jnp.equal(sid, 0), h0_f, recv_f)
-        h_out = stage_fn(lp_local, h_in, mask_fn(afc))
+        h_in = jnp.where(kf_first, h0_f, recv_f)
+        h_out = stage_fn(_slice_lp(lp_local, V, jf), h_in, mask_fn(afc))
         lc, mc = jax.lax.cond(
-            jnp.equal(sid, last),
+            kf_last,
             lambda ops: head_fn(ops[0], ops[1], ops[2], ops[3], scalars),
             lambda ops: _tree_zeros_of(head_struct),
             (rest, h_out, dfc, afc))
-        loss = loss + jnp.where(vf, lc, 0.0)
-        metrics = _tree_add(metrics, _tree_where(vf, mc))
+        live = jnp.logical_and(vf, kf_last)
+        loss = loss + jnp.where(live, lc, 0.0)
+        metrics = _tree_add(metrics, _tree_where(live, mc))
         send_f = jax.lax.ppermute(h_out, "pipe", perm_f)
         return (send_f, loss, metrics), None
 
     carry0 = (zeros_h, jnp.zeros((), jnp.float32),
               _tree_zeros_of(head_struct[1]))
     (_, loss, metrics), _ = jax.lax.scan(tick, carry0,
-                                         jnp.arange(M + S - 1))
+                                         jnp.arange(M * V + S - 1))
     full_red = ("data", "fsdp", "expert", "pipe")
     return jax.lax.psum(loss, full_red), jax.lax.psum(metrics, full_red)
 
 
 def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
-                   S, M, K, T, stage_fn, pre_fn, mask_fn, head_fn,
+                   S, M, K, T, V, stage_fn, pre_fn, mask_fn, head_fn,
                    lp_reduce):
-    """Per-device combined F+B scan (module docstring). Runs inside
-    shard_map; ``lp_local`` is this stage's (possibly fsdp-sharded) layer
-    slice."""
+    """Per-device combined F+B scan (module docstring), generalized over V
+    virtual stages per device (_slot_indices): each slot runs one F
+    sub-slot and one B sub-slot of 1/V stage depth, activations and
+    cotangents hop one device (cyclically) per slot, and the stash ring is
+    per-slice. At V == 1 every index reduces to the plain 1F1B schedule.
+    Runs inside shard_map; ``lp_local`` is this device's (possibly
+    fsdp-sharded) layer slice — for V > 1 in VIRTUAL-STAGE order (the
+    family glue permutes the stack so slice j holds virtual stage
+    j*S + sid; the permutation's AD transpose un-permutes the grads)."""
     sid = jax.lax.axis_index("pipe")
-    last = S - 1
-    perm_f = [(i, i + 1) for i in range(S - 1)]
-    perm_b = [(i + 1, i) for i in range(S - 1)]
+    # V == 1 never reads the wrapped values (stage 0 takes pre_fn, the
+    # last stage seeds from its head vjp), so the plain schedule keeps
+    # the cheaper non-cyclic shifts — interleaving needs the full cycle
+    # (virtual stage j*S+S-1 feeds (j+1)*S+0)
+    if V > 1:
+        perm_f = [(i, (i + 1) % S) for i in range(S)]
+        perm_b = [((i + 1) % S, i) for i in range(S)]
+    else:
+        perm_f = [(i, i + 1) for i in range(S - 1)]
+        perm_b = [(i + 1, i) for i in range(S - 1)]
 
     chunk = lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:])
     diff_c = jax.tree_util.tree_map(chunk, diff_local)
@@ -311,27 +403,29 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
 
     def tick(carry, t):
         recv_f, recv_b, stash, d_lp, d_rest, d_diff, loss, metrics = carry
-        f = t - sid
-        b = t - 2 * (S - 1) + sid
-        fc = jnp.clip(f, 0, M - 1)
-        bc = jnp.clip(b, 0, M - 1)
-        vf = jnp.logical_and(f >= 0, f < M)
-        vb = jnp.logical_and(b >= 0, b < M)
+        jf, cf, vf, jb, cb, vb = _slot_indices(t, sid, S, M, V)
+        fc = jnp.clip(cf, 0, M - 1)
+        bc = jnp.clip(cb, 0, M - 1)
+        kf_first = jnp.logical_and(jnp.equal(sid, 0), jnp.equal(jf, 0))
+        kf_last = jnp.logical_and(jnp.equal(sid, S - 1),
+                                  jnp.equal(jf, V - 1))
+        kb_last = jnp.logical_and(jnp.equal(sid, S - 1),
+                                  jnp.equal(jb, V - 1))
         dfc, afc = _take(diff_c, fc), _take(aux_c, fc)
         dbc, abc = _take(diff_c, bc), _take(aux_c, bc)
 
-        # ---- F slot: forward chunk f through this stage (pre_fn only
-        # feeds stage 0 — cond skips its flops elsewhere; collectives
-        # inside are legal over the tensor axis ONLY, whose peers share
-        # sid and therefore this branch)
+        # ---- F slot: forward chunk cf through virtual slice jf (pre_fn
+        # only feeds virtual stage 0 — cond skips its flops elsewhere;
+        # collectives inside are legal over the tensor axis ONLY, whose
+        # peers share (sid, t) and therefore this branch)
         h0_f = jax.lax.cond(
-            jnp.equal(sid, 0),
+            kf_first,
             lambda ops: pre_fn(ops[0], ops[1], ops[2], scalars),
             lambda ops: zeros_h,
             (rest, dfc, afc))
-        h_in = jnp.where(jnp.equal(sid, 0), h0_f, recv_f)
-        h_out = stage_fn(lp_local, h_in, mask_fn(afc))
-        slot_w = jnp.mod(fc, K)
+        h_in = jnp.where(kf_first, h0_f, recv_f)
+        h_out = stage_fn(_slice_lp(lp_local, V, jf), h_in, mask_fn(afc))
+        slot_w = jf * K + jnp.mod(fc, K)
         prev = jax.lax.dynamic_index_in_dim(stash, slot_w, 0,
                                             keepdims=False)
         stash = jax.lax.dynamic_update_index_in_dim(
@@ -351,44 +445,53 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
         send_f, h_out, lp_b = jax.lax.optimization_barrier(
             (send_f, h_out, lp_local))
 
-        # ---- loss head: only the last stage's value is real (b == f
-        # there, so h_out IS chunk b's blocks output); lax.cond skips the
-        # flops elsewhere at runtime. Collectives inside are legal over
-        # the tensor axis only (same-sid peers — the vocab-parallel head's
-        # psums/pmaxes), never over any other axis.
+        # ---- loss head: only the LAST VIRTUAL stage's value is real
+        # (cb == cf there — its B slot shares the F slot, so h_out IS
+        # chunk cb's final output); lax.cond skips the flops elsewhere.
+        # Collectives inside are legal over the tensor axis only
+        # (same-branch peers — the vocab-parallel head's psums/pmaxes).
         lc, mc, d_rest_h, d_h_out, d_diff_h = jax.lax.cond(
-            jnp.equal(sid, last),
+            kf_last,
             lambda ops: head_and_vjp(*ops),
             lambda ops: _tree_zeros_of(head_struct),
             (rest, h_out, dbc, abc))
 
-        # ---- B slot: backward chunk b — recompute from the stashed stage
-        # input under vjp (activation recompute: residual lifetime is one
-        # tick), consume the cotangent, stream its input-cotangent back.
-        cot_in = jnp.where(jnp.equal(sid, last), d_h_out, recv_b)
-        slot_r = jnp.mod(bc, K)
+        # ---- B slot: backward chunk cb through virtual slice jb —
+        # recompute from the stashed slice input under vjp (activation
+        # recompute: residual lifetime is one slot), consume the
+        # cotangent, stream its input-cotangent back.
+        cot_in = jnp.where(kb_last, d_h_out, recv_b)
+        slot_r = jb * K + jnp.mod(bc, K)
         h_in_b = jax.lax.dynamic_index_in_dim(stash, slot_r, 0,
                                               keepdims=False)
         mask_b = mask_fn(abc)
         _, svjp = jax.vjp(lambda w, h: stage_fn(w, h, mask_b),
-                          lp_b, h_in_b)
+                          _slice_lp(lp_b, V, jb), h_in_b)
         d_lp_c, d_h_in = svjp(cot_in)
 
         d_rest_p, d_diff_p = jax.lax.cond(
-            jnp.equal(sid, 0),
+            jnp.logical_and(jnp.equal(sid, 0), jnp.equal(jb, 0)),
             lambda ops: pre_vjp(*ops),
             lambda ops: _tree_zeros_of(pre_struct),
             (rest, dbc, abc, d_h_in))
 
-        d_lp = _tree_add(d_lp, _tree_where(vb, d_lp_c))
+        # scatter this slot's slice grads into the [V, per, ...] views
+        d_lp = jax.tree_util.tree_map(
+            lambda acc, g: jax.lax.dynamic_update_index_in_dim(
+                acc,
+                jax.lax.dynamic_index_in_dim(acc, jb, 0, keepdims=False)
+                + jnp.where(vb, g, jnp.zeros_like(g)),
+                jb, 0),
+            d_lp, d_lp_c)
         d_rest = _tree_add(d_rest,
                            _tree_where(vb, _tree_add(d_rest_h, d_rest_p)))
         d_diff = jax.tree_util.tree_map(
             lambda buf, g: buf.at[bc].add(jnp.where(vb, g,
                                                     jnp.zeros_like(g))),
             d_diff, _tree_add(d_diff_h, d_diff_p))
-        loss = loss + jnp.where(vb, lc, 0.0)
-        metrics = _tree_add(metrics, _tree_where(vb, mc))
+        live = jnp.logical_and(vb, kb_last)
+        loss = loss + jnp.where(live, lc, 0.0)
+        metrics = _tree_add(metrics, _tree_where(live, mc))
 
         send_b = jax.lax.ppermute(d_h_in, "pipe", perm_b)
         return (send_f, send_b, stash, d_lp, d_rest, d_diff, loss,
@@ -397,11 +500,13 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
     # metrics carry structure: zeros of head_fn's metrics output
     metrics0 = _tree_zeros_of(
         jax.eval_shape(head_fn, rest, zeros_h, d0, a0, scalars)[1])
+    view = lambda a: a.reshape((V, a.shape[0] // V) + a.shape[1:])
     carry0 = (
         zeros_h,                                          # recv_f
         zeros_h,                                          # recv_b
-        jnp.zeros((K,) + h_struct.shape, h_struct.dtype),  # stash
-        jax.tree_util.tree_map(jnp.zeros_like, lp_local),  # d_lp
+        jnp.zeros((V * K,) + h_struct.shape, h_struct.dtype),  # stash
+        jax.tree_util.tree_map(
+            lambda a: jnp.zeros_like(view(a)), lp_local),  # d_lp [V,per,..]
         jax.tree_util.tree_map(jnp.zeros_like, rest),      # d_rest
         jax.tree_util.tree_map(jnp.zeros_like, diff_c),    # d_diff [M,cb,..]
         jnp.zeros((), jnp.float32),                        # loss
@@ -409,6 +514,10 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
     )
     (_, _, _, d_lp, d_rest, d_diff, loss, metrics), _ = jax.lax.scan(
         tick, carry0, jnp.arange(T))
+    # collapse the virtual-slice views back to the stacked layout
+    d_lp = jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+        d_lp)
 
     # ---- cross-device reductions (outside lax.cond — collectives must run
     # on every device). Gathered weights' fsdp reduce-scatter already
@@ -434,6 +543,41 @@ def _schedule_body(lp_local, rest, diff_local, aux_local, scalars, *,
 # re-state each family's pre/head math as pure functions of the param trees
 # (numerics pinned against the flax modules by tests/test_pipeline.py).
 # --------------------------------------------------------------------------
+
+
+def _interleave_stack(lp, S: int, V: int):
+    """Reorder stacked layer weights into VIRTUAL-STAGE order: output
+    block ``s*V + j`` holds virtual stage ``j*S + s``'s layers, so the
+    pipe sharding (contiguous dim-0 blocks per device) gives device s its
+    V non-contiguous slices. Runs OUTSIDE the engine's custom_vjp, so
+    reverse-mode AD transposes the gather and un-permutes the returned
+    grads automatically. On a pipe-sharded array this is a cross-shard
+    permute (one weights-sized collective per step — the interleaving
+    trade)."""
+    import numpy as np
+
+    idx = np.asarray([j * S + s for s in range(S) for j in range(V)])
+
+    def pm(a):
+        per = a.shape[0] // (S * V)
+        return a.reshape((S * V, per) + a.shape[1:])[idx].reshape(a.shape)
+
+    return jax.tree_util.tree_map(pm, lp)
+
+
+def _virtual_stages(model, mesh, lp) -> int:
+    """V for the engine: pp_virtual under the interleaved schedule, else
+    1 — with the layer-divisibility check."""
+    if getattr(model, "pp_schedule", "1f1b") != "interleaved":
+        return 1
+    V = max(int(getattr(model, "pp_virtual", 2)), 1)
+    S = mesh.shape["pipe"]
+    Lc = next(iter(lp.values())).shape[0]
+    if Lc % (S * V):
+        raise ValueError(
+            f"interleaved 1F1B needs num_layers ({Lc}) divisible by "
+            f"pipe axis x pp_virtual ({S} x {V})")
+    return V
 
 
 def _stage_fn_for(model, gather, causal: bool, tp: bool):
@@ -572,9 +716,12 @@ def gpt2_1f1b_losses(model, params, batch) -> Dict[str, jnp.ndarray]:
 
     from .pipeline import stacked_specs
     lp_specs, gather, tp = stacked_specs(mesh, lp)
+    V = _virtual_stages(model, mesh, lp)
+    if V > 1:
+        lp = _interleave_stack(lp, mesh.shape["pipe"], V)
     loss, metrics = pipelined_loss(
         mesh, lp, rest, {}, aux, {"inv_denom": inv_denom},
-        pp_chunks=model.pp_chunks,
+        pp_chunks=model.pp_chunks, pp_virtual=V,
         stage_fn=_stage_fn_for(model, gather, causal=True,
                                tp="manual" if tp else False),
         pre_fn=pre_fn, mask_fn=lambda ac: ac["pad"], head_fn=head_fn,
@@ -644,10 +791,13 @@ def diffuseq_1f1b_losses(model, schedule, params, batch,
 
     from .pipeline import stacked_specs
     lp_specs, gather, tp = stacked_specs(mesh, lp)
+    V = _virtual_stages(model, mesh, lp)
+    if V > 1:
+        lp = _interleave_stack(lp, mesh.shape["pipe"], V)
     mse, _ = pipelined_loss(
         mesh, lp, rest, {"x_t": x_t, "x_start": x_start},
         {"t": t, "pad": pad_mask, "tm": tgt_mask}, {"inv_tgt": inv_tgt},
-        pp_chunks=model.pp_chunks,
+        pp_chunks=model.pp_chunks, pp_virtual=V,
         stage_fn=_stage_fn_for(model, gather, causal=False,
                                tp="manual" if tp else False),
         pre_fn=pre_fn, mask_fn=lambda ac: ac["pad"], head_fn=head_fn,

@@ -79,24 +79,37 @@ def main(namespace: argparse.Namespace) -> None:
     # — together with the step-derived train RNG this makes a resumed run
     # bit-identical. One train step eats one train batch; eval eats one
     # batch per eval_interval steps.
-    from ..utils.checkpoint import resume_target
+    from ..utils.checkpoint import load_meta, resume_target
     resume_step, resume_path = resume_target(ckpt_path,
                                              args.resume_checkpoint)
+    # meta travels WITH the checkpoint: read it from the directory the
+    # resolved model_ lives in (an explicit --resume_checkpoint may point
+    # into another run's dir — the run dir could hold a stale sidecar for
+    # the same step number)
+    meta = (load_meta(os.path.dirname(resume_path.rstrip("/")), resume_step)
+            if resume_step else None)
+    if meta is not None and "eval_batches_consumed" in meta:
+        # the checkpoint records exactly how many eval batches were drawn
+        # — the fast-forward no longer assumes --eval_interval is
+        # unchanged (r4 advisor: 'a warning is not a contract')
+        eval_skip = int(meta["eval_batches_consumed"])
+    else:
+        eval_skip = resume_step // max(args.eval_interval, 1)
+        if resume_step and rank == 0:
+            # pre-meta checkpoint: the division assumes the flag matches
+            logger.warn(
+                f"checkpoint has no meta sidecar; eval-stream "
+                f"fast-forward assumes --eval_interval "
+                f"({args.eval_interval}) is unchanged from the original "
+                f"run (train stream is exact either way)")
     if resume_step and rank == 0:
         logger.info(f"fast-forwarding data stream past {resume_step} "
-                    f"consumed batches (exact-order resume)")
-        # The eval stream's fast-forward divides by eval_interval; the
-        # interval is not recorded in the checkpoint (filenames carry the
-        # step only), so a changed flag silently replays/skips eval
-        # batches while the TRAIN stream stays exact.
-        logger.warn(f"eval-stream fast-forward assumes --eval_interval "
-                    f"({args.eval_interval}) is unchanged from the "
-                    f"original run; eval batches replay or skip if it "
-                    f"differed (train stream is exact either way)")
+                    f"consumed train batches / {eval_skip} eval batches "
+                    f"(exact-order resume)")
     data = load_data_from_args("train", skip_batches=resume_step,
                                **args.dict())
     eval_data = load_data_from_args(
-        "valid", skip_batches=resume_step // max(args.eval_interval, 1),
+        "valid", skip_batches=eval_skip,
         **{**args.dict(), "deterministic": True})
 
     if args.pipe > 1 and not args.scan_layers:
@@ -156,6 +169,7 @@ def main(namespace: argparse.Namespace) -> None:
         # The path resolved above, not args.resume_checkpoint: one discovery,
         # so the stream fast-forward and the restored state cannot desync.
         resume_checkpoint=resume_path,
+        eval_batches_consumed=eval_skip,
         gradient_clipping=args.gradient_clipping,
         weight_decay=args.weight_decay,
         learning_steps=args.learning_steps,

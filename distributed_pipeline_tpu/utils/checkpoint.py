@@ -103,6 +103,38 @@ def resume_target(directory: str,
     return parse_step_from_name(path) or 0, path
 
 
+def save_meta(directory: str, step: int, meta: dict) -> None:
+    """Write the per-checkpoint metadata sidecar (``meta_{step:06d}.json``):
+    run facts the filenames cannot carry — today the consumed-eval-batch
+    count and the eval interval, so a resume can fast-forward the eval
+    stream exactly even when ``--eval_interval`` changed (the r4 advisor's
+    'a warning is not a contract'). Process 0 only; tiny synchronous
+    write."""
+    import json as _json
+
+    if jax.process_index() != 0:
+        return
+    p = epath.Path(directory) / f"meta_{step:06d}.json"
+    try:
+        p.write_text(_json.dumps(meta))
+    except Exception as e:  # metadata must never fail a save
+        logger.warn(f"checkpoint meta write failed ({p}): {e}")
+
+
+def load_meta(directory: str, step: int) -> Optional[dict]:
+    """The sidecar written by :func:`save_meta`, or None (pre-r5
+    checkpoints have none — callers fall back to flag-derived values)."""
+    import json as _json
+
+    p = epath.Path(directory) / f"meta_{step:06d}.json"
+    try:
+        if p.is_file():
+            return _json.loads(p.read_text())
+    except Exception as e:
+        logger.warn(f"checkpoint meta read failed ({p}): {e}")
+    return None
+
+
 def find_ema_checkpoint(directory: str, step: int, rate: str) -> Optional[str]:
     path = epath.Path(directory) / f"ema_{rate}_{step:06d}"
     return os.fspath(path) if path.is_dir() else None
@@ -218,12 +250,15 @@ def prune_checkpoints(directory: str, keep: int) -> List[int]:
     failed = set()
     touched = set()
     for child, name in children:
-        if (name.startswith(("model_", "ema_", "opt_"))
+        if (name.startswith(("model_", "ema_", "opt_", "meta_"))
                 and parse_step_from_name(name) in doomed):
             step = parse_step_from_name(name)
             touched.add(step)
             try:
-                child.rmtree()
+                if name.startswith("meta_"):
+                    child.unlink()
+                else:
+                    child.rmtree()
             # broad by design: epath's gs:// backends surface failures as
             # tf.errors.OpError / gcsfs HttpError etc., not OSError
             except Exception as e:

@@ -407,14 +407,20 @@ class Logger:
             folded[1] += len(buf) - keep
             del buf[:-keep]
 
-    def merged_kvs(self) -> Dict[str, Any]:
+    def merged_kvs(self, return_counts: bool = False):
         """Overwrite-keys plus materialized means (device scalars become
         floats here — the single sync point). ALL buffered device scalars
         transfer in ONE device_get: fetching them one-by-one costs a full
         device round trip each, which on a remote-tunneled accelerator turns
         a dump into a minute-long stall (measured 60s/dump on the v5e
-        tunnel at log_interval=100 — 4x total training slowdown)."""
+        tunnel at log_interval=100 — 4x total training slowdown).
+
+        ``return_counts=True`` additionally returns each key's sample
+        count (overwrite keys count 1) — what the cross-process comm
+        weights by, matching the reference's ``mpi_weighted_mean``
+        (logger.py:418-445) semantics for uneven per-host counts."""
         d = dict(self.name2val)
+        counts = {k: 1 for k in d}
         keys = sorted(set(self.name2mean) | set(self.name2mean_folded))
         flat: list = []
         spans = {}
@@ -430,14 +436,21 @@ class Logger:
             count = n + ln
             if count:
                 d[key] = total / count
-        return d
+                counts[key] = count
+        return (d, counts) if return_counts else d
 
     def dumpkvs(self) -> Dict[str, Any]:
         if self.level == DISABLED:
             return {}
-        d = self.merged_kvs()
+        d, counts = self.merged_kvs(return_counts=True)
         if self.comm is not None:
-            d = self.comm(d)
+            import inspect
+            try:
+                two_arg = len(inspect.signature(
+                    self.comm).parameters) >= 2
+            except (TypeError, ValueError):  # builtins/partials: assume new
+                two_arg = True
+            d = self.comm(d, counts) if two_arg else self.comm(d)
         if _process_index() == 0:
             for fmt in self.output_formats:
                 if isinstance(fmt, KVWriter):
@@ -487,11 +500,15 @@ def append_output_format(fmt: str) -> None:
 
 
 def distributed_mean_comm():
-    """Returns a comm callable averaging numeric metrics across JAX processes
-    (replaces the reference's MPI ``mpi_weighted_mean``, logger.py:418-445).
-    Multi-host safe: uses ``multihost_utils.process_allgather``. No-op when
-    single-process."""
-    def comm(d: Dict[str, Any]) -> Dict[str, Any]:
+    """Returns a comm callable averaging numeric metrics across JAX
+    processes, COUNT-WEIGHTED like the reference's ``mpi_weighted_mean``
+    (logger.py:418-445): each rank contributes (value * count, count) per
+    key and the merged metric is sum(v*c)/sum(c), so uneven per-host
+    sample counts (ragged eval tails, rank-gated logging cadence) do not
+    skew the mean. Multi-host safe via
+    ``multihost_utils.process_allgather``. No-op when single-process."""
+    def comm(d: Dict[str, Any],
+             counts: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
         import jax
         if jax.process_count() == 1:
             return d
@@ -511,9 +528,14 @@ def distributed_mean_comm():
             warnings.warn("distributed_mean: metric key sets differ across "
                           "processes; skipping cross-process averaging")
             return d
-        local = np.array([float(d[k]) for k in keys], dtype=np.float64)
-        gathered = multihost_utils.process_allgather(local)
-        mean = np.asarray(gathered).reshape(jax.process_count(), -1).mean(axis=0)
+        counts = counts or {}
+        cnt = np.array([float(counts.get(k, 1) or 1) for k in keys],
+                       dtype=np.float64)
+        val = np.array([float(d[k]) for k in keys], dtype=np.float64)
+        local = np.stack([val * cnt, cnt])                  # [2, K]
+        gathered = np.asarray(multihost_utils.process_allgather(local))
+        sums = gathered.reshape(jax.process_count(), 2, -1).sum(axis=0)
+        mean = sums[0] / np.maximum(sums[1], 1.0)
         out = dict(d)
         out.update({k: float(m) for k, m in zip(keys, mean)})
         return out

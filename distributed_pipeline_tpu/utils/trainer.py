@@ -111,6 +111,7 @@ class TrainLoop:
         profile_dir: str = "",
         warmup_steps: int = 0,
         keep_checkpoints: int = 0,
+        eval_batches_consumed: int = 0,
     ) -> None:
         self.workload = model
         self.data = data
@@ -128,6 +129,10 @@ class TrainLoop:
             r.strip() for r in str(ema_rate).split(",") if r.strip())
         self.log_interval = log_interval
         self.eval_interval = eval_interval
+        # cumulative eval batches drawn (incl. before a resume) — recorded
+        # in each checkpoint's meta sidecar so resumes fast-forward the
+        # eval stream exactly even if --eval_interval changed
+        self.eval_batches_consumed = eval_batches_consumed
         self.save_interval = save_interval
         self.gradient_clipping = gradient_clipping
         self.weight_decay = weight_decay
@@ -427,6 +432,7 @@ class TrainLoop:
                 if (self.eval_data is not None
                         and self.step % self.eval_interval == 0):
                     self.forward_only(next(self.eval_data))
+                    self.eval_batches_consumed += 1
                     # Reference runs callbacks on rank 0 only
                     # (trainer.py:189-191) because torch callbacks are
                     # host-local. Here they may jit over globally-sharded
@@ -471,6 +477,10 @@ class TrainLoop:
             self.checkpoint_dir, self.step, self.state.params,
             ema={r: self.state.ema[r] for r in self.ema_rates},
             opt_state=self.state.opt_state, wait=wait)
+        ckpt_lib.save_meta(self.checkpoint_dir, self.step, {
+            "eval_batches_consumed": self.eval_batches_consumed,
+            "eval_interval": self.eval_interval,
+        })
         mode = ("saved checkpoint" if wait
                 else "scheduled async checkpoint save")
         logger.info(f"{mode} at step {self.step} -> {self.checkpoint_dir}")
