@@ -69,6 +69,10 @@ def create_distributed_parser() -> argparse.ArgumentParser:
                    help="capture each spawned worker's stdout+stderr to "
                         "{log_dir}/worker_{i}.log (torchrun --log_dir/-r "
                         "redirects, dist_run.py:163-189); restarts append")
+    p.add_argument("--log_tee", action="store_true",
+                   help="with --log_dir: ALSO stream each worker's output "
+                        "to this console, '[worker N]'-prefixed (torchrun "
+                        "-t tee, dist_run.py:180-189)")
     return p
 
 
@@ -87,7 +91,8 @@ def parse_distributed_args(
     epilog = ("launcher options: --distributed "
               "[--coordinator_address H:P] [--num_processes N] "
               "[--process_id I] [--nprocs N] [--devices_per_proc K] "
-              "[--max_restarts R] [--monitor_interval S]")
+              "[--max_restarts R] [--monitor_interval S] "
+              "[--log_dir DIR] [--log_tee]")
     if epilog not in (parser.epilog or ""):
         parser.epilog = ((parser.epilog or "") + "\n\n" + epilog)
     return dist_ns, rest
@@ -105,10 +110,37 @@ def get_main_modname() -> Optional[str]:
     return None
 
 
+def _tee_pump(proc, sink, prefix: str):
+    """Daemon thread streaming one worker's piped output to BOTH its log
+    file and this console (torchrun -t tee semantics, dist_run.py:180-189).
+    Returns the thread (joined before the log file closes)."""
+    import threading
+
+    def pump():
+        echo = True
+        for line in iter(proc.stdout.readline, b""):
+            # the log file ALWAYS gets the line; a broken console (closed
+            # stream, reader exited under a pipe) only disables the echo —
+            # stopping the pump would deadlock the worker on a full pipe
+            sink.write(line)
+            sink.flush()
+            if echo:
+                try:
+                    sys.stdout.write(
+                        f"{prefix} {line.decode(errors='replace')}")
+                    sys.stdout.flush()
+                except (ValueError, OSError):
+                    echo = False
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
 def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      monitor_interval: float,
                      run_timestamp: Optional[str] = None,
-                     log_dir: str = "") -> int:
+                     log_dir: str = "", log_tee: bool = False) -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -124,9 +156,12 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     print(f"[launcher] worker cmd: {' '.join(cmd_base)}")  # cmdline echo,
     # like reference dist_run.py:36-44
     logs = []
+    tee_threads = []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-        print(f"[launcher] per-worker output -> {log_dir}/worker_N.log")
+        mode = "tee'd to console and" if log_tee else "->"
+        print(f"[launcher] per-worker output {mode} "
+              f"{log_dir}/worker_N.log")
     procs = []
     # The spawn loop sits INSIDE the try: if opening worker k's log or its
     # Popen raises (OSError mid-loop), the finally still closes every
@@ -158,8 +193,18 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                 # attempt boundary is visible from the launcher's own log)
                 f = open(os.path.join(log_dir, f"worker_{i}.log"), "ab")
                 logs.append(f)
-                procs.append(subprocess.Popen(cmd_base, env=env, stdout=f,
-                                              stderr=subprocess.STDOUT))
+                if log_tee:
+                    # pipe through a pump thread: file AND console get
+                    # every line (reference -t tee, dist_run.py:180-189)
+                    proc = subprocess.Popen(cmd_base, env=env,
+                                            stdout=subprocess.PIPE,
+                                            stderr=subprocess.STDOUT)
+                    tee_threads.append(_tee_pump(proc, f, f"[worker {i}]"))
+                    procs.append(proc)
+                else:
+                    procs.append(subprocess.Popen(
+                        cmd_base, env=env, stdout=f,
+                        stderr=subprocess.STDOUT))
             else:
                 procs.append(subprocess.Popen(cmd_base, env=env))
         codes = [None] * len(procs)
@@ -191,6 +236,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                 p.terminate()
         raise
     finally:
+        for t in tee_threads:
+            t.join(timeout=5)  # drain piped output before closing files
         for f in logs:
             f.close()
     # Any nonzero code fails the attempt — max() would mask a signal-killed
@@ -202,7 +249,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             nprocs: int, devices_per_proc: int = 2,
                             max_restarts: int = 0,
                             monitor_interval: float = 0.2,
-                            log_dir: str = "") -> int:
+                            log_dir: str = "", log_tee: bool = False) -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
@@ -232,7 +279,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     while True:
         code = _run_worker_ring(cmd_base, nprocs, devices_per_proc,
                                 monitor_interval, run_timestamp,
-                                log_dir=log_dir)
+                                log_dir=log_dir, log_tee=log_tee)
         if code == 0 or attempt >= max_restarts:
             return code
         attempt += 1
@@ -265,7 +312,8 @@ def parse_and_autorun(
                                        dist_ns.devices_per_proc,
                                        max_restarts=dist_ns.max_restarts,
                                        monitor_interval=dist_ns.monitor_interval,
-                                       log_dir=dist_ns.log_dir)
+                                       log_dir=dist_ns.log_dir,
+                                       log_tee=dist_ns.log_tee)
         sys.exit(code)
 
     if dist_ns.distributed:

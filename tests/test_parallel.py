@@ -306,3 +306,23 @@ def test_multiprocess_decode_callback(tmp_path):
                 if line.startswith("DECODE "))
     assert set(vals) == {"0", "1"}, out.stdout
     assert vals["0"] == vals["1"] != "None"
+
+
+def test_launcher_log_tee(tmp_path, capfd):
+    """--log_tee (torchrun -t tee): each worker's output reaches BOTH its
+    log file and the launcher console, '[worker N]'-prefixed."""
+    import sys
+
+    from distributed_pipeline_tpu.parallel.launcher import _run_worker_ring
+
+    code = _run_worker_ring(
+        [sys.executable, "-c", "print('tee-marker-xyz')"],
+        nprocs=2, devices_per_proc=1, monitor_interval=0.05,
+        log_dir=str(tmp_path), log_tee=True)
+    assert code == 0
+    out, _ = capfd.readouterr()
+    # the cmdline echo also contains the marker; count teed WORKER lines
+    assert out.count("] tee-marker-xyz") == 2
+    assert "[worker 0]" in out and "[worker 1]" in out
+    for i in range(2):
+        assert "tee-marker-xyz" in (tmp_path / f"worker_{i}.log").read_text()

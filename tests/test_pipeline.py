@@ -466,9 +466,10 @@ def test_moe_scan_rejects_indivisible_layers():
                                  moe_experts=4, moe_every=2)
 
 
-def test_scan_layers_greedy_decode_falls_back_to_recompute():
-    """Stacked models have no KV cache yet: cached decode silently uses the
-    (identical-output) full-recompute path instead of crashing."""
+def test_scan_layers_greedy_decode_preserves_prompt():
+    """Smoke for stacked-model greedy decode (the KV-cache path since r4;
+    full cache-vs-recompute parity lives in tests/test_sampling.py): the
+    prompt must pass through untouched."""
     from distributed_pipeline_tpu.models.sampling import gpt2_greedy_decode
 
     wl = stacked_workload()
