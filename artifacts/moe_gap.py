@@ -1,0 +1,271 @@
+"""Decompose the MoE MFU gap (r4 bench: 62.6% moe8 vs 76.5% dense on
+diffuseq-base seq128, MFU vs ACTIVE params).
+
+Times ONE MLP sublayer at the bench microbatch shape (B=64, L=128,
+D=768, M=4D, E=8, K=2) — dense vs the routed mixture — fwd and
+fwd+bwd, long-chain differenced on the real chip (see flash_sweep.py
+for the method). Variants:
+
+  dense          backbone.Mlp math (the anchor; active MoE compute
+                 = K x this, so `K * dense` is the zero-overhead ideal)
+  moe-cf1.25     moe_mlp_fwd at the shipped defaults
+  moe-cf1.0      capacity_factor 1.0 (no padding slots beyond K*L)
+  moe-cf1.25-k1  top-1 routing (Switch), cf 1.25
+  moe-machinery  router + top-k + capacity cumsum + combine/dispatch
+                 build ONLY (no expert matmuls): the non-MXU overhead
+  moe-bf16comb   fork of moe_mlp_fwd building the [B, L, E, C] combine
+                 tensor in bf16 (halves its HBM footprint)
+
+Interpretation key (written up in PARITY.md "MoE" section): with slots
+= E*C = K*cf*L, the expert matmuls compute cf x the active flops, so
+even a zero-overhead dispatch caps MFU-vs-active at dense_MFU/cf on
+the MLP share of the model. The measured rows separate that
+algorithmic padding from implementation overhead (dispatch einsums +
+routing machinery).
+"""
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributed_pipeline_tpu.models.moe import moe_mlp_fwd
+
+B, L, D, E = 64, 128, 768, 8
+M = 4 * D
+
+
+def drain(out):
+    float(jax.device_get(jnp.sum(jax.tree_util.tree_leaves(out)[0])
+                         .astype(jnp.float32)))
+
+
+def chain_total(step, reps, *args):
+    @jax.jit
+    def chain(x, mp):
+        def body(_, c):
+            return step(c, mp)
+        return jax.lax.fori_loop(0, reps, body, x)
+    drain(chain(*args))
+    t0 = time.perf_counter()
+    drain(chain(*args))
+    return time.perf_counter() - t0
+
+
+def make_params(key):
+    ks = jax.random.split(key, 4)
+    init = lambda k, *s: jax.random.normal(k, s, jnp.float32) * 0.02
+    return {
+        "router": init(ks[0], D, E),
+        "wi": init(ks[1], E, D, M), "wo": init(ks[2], E, M, D),
+        # dense anchor weights (same fan-in init)
+        "dwi": init(ks[3], D, M), "dwo": init(ks[3], M, D),
+    }
+
+
+def dense_fwd(mp, x):
+    h = jnp.einsum("bld,dm->blm", x, mp["dwi"].astype(jnp.bfloat16))
+    h = nn.gelu(h, approximate=True)
+    return jnp.einsum("blm,md->bld", h, mp["dwo"].astype(jnp.bfloat16))
+
+
+def moe_fwd(mp, x, *, top_k, cf):
+    sub = {"router": mp["router"], "wi": mp["wi"], "wo": mp["wo"]}
+    y, _aux, _ = moe_mlp_fwd(sub, x, None, top_k=top_k,
+                             capacity_factor=cf, dtype=jnp.bfloat16)
+    return y
+
+
+def moe_machinery(mp, x, *, top_k, cf):
+    """Everything except the expert matmuls: the routing/dispatch
+    overhead in isolation. Reimplements moe_mlp_fwd's plan build, then
+    contracts combine straight against x (one cheap einsum) so nothing
+    is DCE'd."""
+    import math
+    K, C = top_k, max(1, math.ceil(L / E * cf * top_k))
+    logits = jnp.einsum("bld,de->ble", x.astype(jnp.float32), mp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    remaining, gates, masks = probs, [], []
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        remaining = remaining * (1.0 - mask)
+        gates.append((probs * mask).sum(-1))
+        masks.append(mask)
+    claims = jnp.stack(masks, axis=2).reshape(B, L * K, E)
+    pos = jnp.cumsum(claims, axis=1) - claims
+    keep_flat = claims * (pos < C)
+    slot_idx = (pos * keep_flat).sum(-1).astype(jnp.int32)
+    slot_flat = jax.nn.one_hot(slot_idx, C, dtype=jnp.float32)
+    keep = keep_flat.reshape(B, L, K, E)
+    slot = slot_flat.reshape(B, L, K, C)
+    kept_gate = [g * keep[:, :, k].sum(-1) for k, g in enumerate(gates)]
+    denom = jnp.maximum(sum(kept_gate), 1e-9)
+    combine = jnp.zeros((B, L, E, C), jnp.float32)
+    for k, g in enumerate(gates):
+        w = (g / denom)[..., None] * keep[:, :, k]
+        combine = combine + w[..., None] * slot[:, :, k][:, :, None, :]
+    # consume the plan without the expert MLPs
+    return x + jnp.einsum("blec,bld->bld", combine.astype(x.dtype), x) * 1e-6
+
+
+def moe_fwd_bf16comb(mp, x, *, top_k, cf):
+    """moe_mlp_fwd fork: combine built directly in bf16."""
+    import math
+    K, C = top_k, max(1, math.ceil(L / E * cf * top_k))
+    logits = jnp.einsum("bld,de->ble", x.astype(jnp.float32), mp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    remaining, gates, masks = probs, [], []
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        remaining = remaining * (1.0 - mask)
+        gates.append((probs * mask).sum(-1))
+        masks.append(mask)
+    claims = jnp.stack(masks, axis=2).reshape(B, L * K, E)
+    pos = jnp.cumsum(claims, axis=1) - claims
+    keep_flat = claims * (pos < C)
+    slot_idx = (pos * keep_flat).sum(-1).astype(jnp.int32)
+    slot_flat = jax.nn.one_hot(slot_idx, C, dtype=jnp.float32)
+    keep = keep_flat.reshape(B, L, K, E)
+    slot = slot_flat.reshape(B, L, K, C)
+    kept_gate = [g * keep[:, :, k].sum(-1) for k, g in enumerate(gates)]
+    denom = jnp.maximum(sum(kept_gate), 1e-9)
+    combine = jnp.zeros((B, L, E, C), jnp.bfloat16)
+    for k, g in enumerate(gates):
+        w = ((g / denom)[..., None] * keep[:, :, k]).astype(jnp.bfloat16)
+        combine = combine + w[..., None] * slot[:, :, k][
+            :, :, None, :].astype(jnp.bfloat16)
+    dispatch = (combine > 0).astype(jnp.bfloat16)
+    xin = jnp.einsum("blec,bld->ebcd", dispatch, x.astype(jnp.bfloat16))
+    h = jnp.einsum("ebcd,edm->ebcm", xin, mp["wi"].astype(jnp.bfloat16))
+    h = nn.gelu(h, approximate=True)
+    out = jnp.einsum("ebcm,emd->ebcd", h, mp["wo"].astype(jnp.bfloat16))
+    return jnp.einsum("blec,ebcd->bld", combine, out).astype(x.dtype)
+
+
+def main():
+    mp = make_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, L, D), jnp.bfloat16)
+
+    variants = [
+        ("dense", dense_fwd),
+        ("moe-cf1.25", functools.partial(moe_fwd, top_k=2, cf=1.25)),
+        ("moe-cf1.0", functools.partial(moe_fwd, top_k=2, cf=1.0)),
+        ("moe-cf1.25-k1", functools.partial(moe_fwd, top_k=1, cf=1.25)),
+        ("moe-machinery", functools.partial(moe_machinery, top_k=2, cf=1.25)),
+        ("moe-bf16comb",
+         functools.partial(moe_fwd_bf16comb, top_k=2, cf=1.25)),
+    ]
+    for name, f in variants:
+        def step_fwd(c, mp_):
+            return f(mp_, c).astype(c.dtype)
+
+        def step_bwd(c, mp_):
+            g = jax.grad(lambda w, xx: jnp.sum(
+                f(w, xx).astype(jnp.float32) ** 2), argnums=(0, 1))
+            dw, dx = g(mp_, c)
+            leaves = jax.tree_util.tree_leaves(dw)
+            bump = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+            return (c + dx * 0 + bump.astype(c.dtype) * 1e-30).astype(c.dtype)
+
+        row = {"variant": name}
+        for kind, stepf, lo, hi in [("fwd", step_fwd, 32, 160),
+                                    ("fwdbwd", step_bwd, 16, 80)]:
+            margs = []
+            for _ in range(2):
+                t_lo = chain_total(stepf, lo, x, mp)
+                t_hi = chain_total(stepf, hi, x, mp)
+                margs.append((t_hi - t_lo) / (hi - lo) * 1e3)
+            row[kind + "_ms"] = round(min(margs), 4)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__" and "--sweep" not in sys.argv:
+    main()
+
+
+def moe_fwd_c(mp, x, *, top_k, C, reshape_gemm=False):
+    """moe_mlp_fwd with the slot count C forced directly (alignment
+    probe), optionally reshaping [E, B, C, D] -> [E, B*C, D] so the
+    expert matmuls are unambiguous single GEMMs per expert."""
+    K = top_k
+    logits = jnp.einsum("bld,de->ble", x.astype(jnp.float32), mp["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    remaining, gates, masks = probs, [], []
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        remaining = remaining * (1.0 - mask)
+        gates.append((probs * mask).sum(-1))
+        masks.append(mask)
+    claims = jnp.stack(masks, axis=2).reshape(B, L * K, E)
+    pos = jnp.cumsum(claims, axis=1) - claims
+    keep_flat = claims * (pos < C)
+    slot_idx = (pos * keep_flat).sum(-1).astype(jnp.int32)
+    slot_flat = jax.nn.one_hot(slot_idx, C, dtype=jnp.float32)
+    keep = keep_flat.reshape(B, L, K, E)
+    slot = slot_flat.reshape(B, L, K, C)
+    kept_gate = [g * keep[:, :, k].sum(-1) for k, g in enumerate(gates)]
+    denom = jnp.maximum(sum(kept_gate), 1e-9)
+    combine = jnp.zeros((B, L, E, C), jnp.float32)
+    for k, g in enumerate(gates):
+        w = (g / denom)[..., None] * keep[:, :, k]
+        combine = combine + w[..., None] * slot[:, :, k][:, :, None, :]
+    dispatch = (combine > 0).astype(x.dtype)
+    xin = jnp.einsum("blec,bld->ebcd", dispatch, x.astype(jnp.bfloat16))
+    if reshape_gemm:
+        xin2 = xin.reshape(E, B * C, D)
+        h = jnp.einsum("exd,edm->exm", xin2, mp["wi"].astype(jnp.bfloat16))
+        h = nn.gelu(h, approximate=True)
+        out = jnp.einsum("exm,emd->exd", h, mp["wo"].astype(jnp.bfloat16))
+        out = out.reshape(E, B, C, D)
+    else:
+        h = jnp.einsum("ebcd,edm->ebcm", xin, mp["wi"].astype(jnp.bfloat16))
+        h = nn.gelu(h, approximate=True)
+        out = jnp.einsum("ebcm,emd->ebcd", h, mp["wo"].astype(jnp.bfloat16))
+    return jnp.einsum("blec,ebcd->bld",
+                      combine.astype(jnp.bfloat16), out).astype(x.dtype)
+
+
+def main_sweep():
+    mp = make_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, L, D), jnp.bfloat16)
+    variants = []
+    for C in (32, 40, 48, 64):
+        variants.append((f"moe-K2-C{C}",
+                         functools.partial(moe_fwd_c, top_k=2, C=C)))
+    variants.append(("moe-K2-C40-gemm",
+                     functools.partial(moe_fwd_c, top_k=2, C=40,
+                                       reshape_gemm=True)))
+    for name, f in variants:
+        def step_fwd(c, mp_):
+            return f(mp_, c).astype(c.dtype)
+
+        def step_bwd(c, mp_):
+            g = jax.grad(lambda w, xx: jnp.sum(
+                f(w, xx).astype(jnp.float32) ** 2), argnums=(0, 1))
+            dw, dx = g(mp_, c)
+            leaves = jax.tree_util.tree_leaves(dw)
+            bump = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+            return (c + dx * 0 + bump.astype(c.dtype) * 1e-30).astype(c.dtype)
+
+        row = {"variant": name}
+        for kind, stepf, lo, hi in [("fwd", step_fwd, 32, 160),
+                                    ("fwdbwd", step_bwd, 16, 80)]:
+            margs = []
+            for _ in range(2):
+                t_lo = chain_total(stepf, lo, x, mp)
+                t_hi = chain_total(stepf, hi, x, mp)
+                margs.append((t_hi - t_lo) / (hi - lo) * 1e3)
+            row[kind + "_ms"] = round(min(margs), 4)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__" and "--sweep" in sys.argv:
+    main_sweep()

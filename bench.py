@@ -47,6 +47,7 @@ def main() -> None:
                 batch, microbatch: int = 0, remat: bool = False,
                 vocab: int = 8192, attention_impl: str = "auto",
                 moe_experts: int = 0, moe_top_k: int = 2,
+                moe_capacity_factor: float = 1.25,
                 scan_layers: bool = False):
         """tokens/sec for one config; warmup step compiles, then a timed
         window. ``batch`` is PER HOST (reference trainer.py:89 semantics:
@@ -66,6 +67,7 @@ def main() -> None:
                                    vocab=vocab, attention_impl=attention_impl,
                                    moe_experts=moe_experts,
                                    moe_top_k=moe_top_k,
+                                   moe_capacity_factor=moe_capacity_factor,
                                    scan_layers=scan_layers)
                 except Exception as e:
                     if i == len(batch) - 1:
@@ -84,6 +86,7 @@ def main() -> None:
             model_family=family, model_size=size, seq_len=seq_len,
             dtype=dtype, remat=remat, attention_impl=attention_impl,
             moe_experts=moe_experts, moe_top_k=moe_top_k,
+            moe_capacity_factor=moe_capacity_factor,
             scan_layers=scan_layers, **dims)
         dataset = "synthetic-lm" if family == "gpt2" else "synthetic-seq2seq"
         data = load_data_from_args("train", batch_size=batch, dataset=dataset,
@@ -250,6 +253,15 @@ def main() -> None:
         measure("diffuseq-base-seq128-moe8", family="diffuseq", size="base",
                 seq_len=128, batch=(bsz(256), bsz(64)),
                 microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2),
+        # Same MoE at capacity_factor 1.0: zero padding slots (E*C == K*L).
+        # artifacts/moe_gap.py decomposes the moe8 MFU gap — at cf 1.25 the
+        # expert GEMMs pay ~2x the +25% slot flops (non-power-of-two row
+        # tiling), at cf 1.0 they run at dense efficiency; the knob
+        # (--moe_capacity_factor) trades overflow drops for throughput.
+        measure("diffuseq-base-seq128-moe8-cf1", family="diffuseq",
+                size="base", seq_len=128, batch=(bsz(256), bsz(64)),
+                microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2,
+                moe_capacity_factor=1.0),
         # scan_layers: the stacked-weights layer scan (one traced block) —
         # quantifies the compile-time-vs-MFU tradeoff PARITY.md documents,
         # in the driver signal.

@@ -87,6 +87,9 @@ class ModelSettings(S):
     moe_experts: int = _(0, "mixture-of-experts: expert count (0 = dense MLPs)")
     moe_top_k: int = _(2, "MoE router top-k")
     moe_every: int = _(2, "MoE replaces the MLP in every k-th block")
+    moe_capacity_factor: float = _(
+        1.25, "MoE expert capacity = ceil(L/E * factor * top_k) slots; "
+        "tokens over capacity fall through on the residual path")
     scan_layers: bool = _(False, "stacked layer weights (lax.scan over "
                                  "blocks; enables pipeline parallelism and "
                                  "fast compiles for deep models)")

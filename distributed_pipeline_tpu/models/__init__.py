@@ -113,7 +113,9 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                              dtype: str = "bfloat16", remat: bool = False,
                              attention_impl: str = "auto",
                              moe_experts: int = 0, moe_top_k: int = 2,
-                             moe_every: int = 2, scan_layers: bool = False,
+                             moe_every: int = 2,
+                             moe_capacity_factor: float = 1.25,
+                             scan_layers: bool = False,
                              pp_chunks: int = 4, pp_schedule: str = "1f1b",
                              pp_virtual: int = 2, scan_unroll: int = 0,
                              **_unused: Any) -> Workload:
@@ -145,7 +147,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
             num_layers=layers, num_heads=heads, emb_dim=DIFFUSEQ_EMB_DIM,
             dtype=jdtype, remat=remat, attention_impl=attention_impl,
             moe_experts=moe_experts, moe_top_k=moe_top_k,
-            moe_every=moe_every, scan_layers=scan_layers,
+            moe_every=moe_every, moe_capacity_factor=moe_capacity_factor,
+            scan_layers=scan_layers,
             pp_chunks=pp_chunks, pp_schedule=pp_schedule,
             pp_virtual=pp_virtual, scan_unroll=scan_unroll)
         schedule = make_schedule(noise_schedule, diffusion_steps)
@@ -165,6 +168,7 @@ def create_model_from_config(*, model_family: str = "diffuseq",
             num_layers=layers, num_heads=heads, dtype=jdtype, remat=remat,
             attention_impl=attention_impl, moe_experts=moe_experts,
             moe_top_k=moe_top_k, moe_every=moe_every,
+            moe_capacity_factor=moe_capacity_factor,
             scan_layers=scan_layers, pp_chunks=pp_chunks,
             pp_schedule=pp_schedule, pp_virtual=pp_virtual,
             scan_unroll=scan_unroll)

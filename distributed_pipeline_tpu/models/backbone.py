@@ -145,6 +145,7 @@ class Block(nn.Module):
     decode: bool = False
     moe_experts: int = 0
     moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     moe_no_drop: bool = False
 
     @nn.compact
@@ -159,6 +160,7 @@ class Block(nn.Module):
         if self.moe_experts > 0:
             from .moe import MoEMlp  # function-level: moe imports backbone
             x = x + MoEMlp(self.moe_experts, self.moe_top_k,
+                           capacity_factor=self.moe_capacity_factor,
                            dtype=self.dtype, no_drop=self.moe_no_drop,
                            name="moe")(h, pad_mask)
         else:
@@ -184,6 +186,7 @@ class TransformerBackbone(nn.Module):
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_every: int = 2  # MoE replaces the MLP in every moe_every-th block
+    moe_capacity_factor: float = 1.25
     moe_no_drop: bool = False
     scan_layers: bool = False  # stacked weights: lax.scan over layers, and
     # GPipe pipeline streaming when the mesh has a pipe axis > 1
@@ -202,6 +205,7 @@ class TransformerBackbone(nn.Module):
                     dtype=self.dtype, causal=self.causal,
                     moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
                     moe_every=self.moe_every,
+                    capacity_factor=self.moe_capacity_factor,
                     moe_no_drop=self.moe_no_drop, remat=self.remat,
                     attention_impl=self.attention_impl,
                     scan_unroll=self.scan_unroll,
@@ -228,7 +232,9 @@ class TransformerBackbone(nn.Module):
                       and i % self.moe_every == self.moe_every - 1)
             x = block_cls(self.num_heads, self.dtype, self.causal,
                           self.attention_impl, self.decode,
-                          self.moe_experts if is_moe else 0, self.moe_top_k,
-                          self.moe_no_drop,
+                          moe_experts=self.moe_experts if is_moe else 0,
+                          moe_top_k=self.moe_top_k,
+                          moe_capacity_factor=self.moe_capacity_factor,
+                          moe_no_drop=self.moe_no_drop,
                           name=f"block_{i}")(x, pad_mask, cache_index)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x).astype(self.dtype)

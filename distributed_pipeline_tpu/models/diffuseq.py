@@ -60,6 +60,7 @@ class DiffuSeqModel(nn.Module):
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_every: int = 2
+    moe_capacity_factor: float = 1.25
     moe_no_drop: bool = False
     scan_layers: bool = False
     pp_chunks: int = 4
@@ -99,7 +100,9 @@ class DiffuSeqModel(nn.Module):
             self.num_layers, self.num_heads, self.dtype, self.remat,
             causal=False, attention_impl=self.attention_impl,
             moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
-            moe_every=self.moe_every, moe_no_drop=self.moe_no_drop,
+            moe_every=self.moe_every,
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_no_drop=self.moe_no_drop,
             scan_layers=self.scan_layers, pp_chunks=self.pp_chunks,
             scan_unroll=self.scan_unroll,
             name="backbone")

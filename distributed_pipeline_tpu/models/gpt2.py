@@ -40,6 +40,7 @@ class GPT2Model(nn.Module):
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_every: int = 2
+    moe_capacity_factor: float = 1.25
     moe_no_drop: bool = False
     scan_layers: bool = False
     pp_chunks: int = 4
@@ -80,6 +81,7 @@ class GPT2Model(nn.Module):
                                 moe_experts=self.moe_experts,
                                 moe_top_k=self.moe_top_k,
                                 moe_every=self.moe_every,
+                                moe_capacity_factor=self.moe_capacity_factor,
                                 moe_no_drop=self.moe_no_drop,
                                 scan_layers=self.scan_layers,
                                 pp_chunks=self.pp_chunks,

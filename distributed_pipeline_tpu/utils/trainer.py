@@ -417,7 +417,10 @@ class TrainLoop:
     def run_loop(self) -> None:
         """Interval-driven outer loop (reference run_loop trainer.py:175-196):
         log every ``log_interval``, eval every ``eval_interval``, save every
-        ``save_interval``, final save on exit."""
+        ``save_interval``, final save on exit. An interval <= 0 disables
+        that periodic action (the reference's modulo would die on 0); the
+        final save still runs with periodic saves disabled, so every run
+        leaves a restorable checkpoint."""
         loop_step = 0
         try:
             while self.learning_steps <= 0 or self.step < self.learning_steps:
@@ -426,10 +429,10 @@ class TrainLoop:
                 batch = next(self.data)
                 self.run_step(batch)
                 loop_step += 1
-                if self.step % self.log_interval == 0:
+                if self.log_interval > 0 and self.step % self.log_interval == 0:
                     self._log_throughput()
                     logger.dumpkvs()
-                if (self.eval_data is not None
+                if (self.eval_data is not None and self.eval_interval > 0
                         and self.step % self.eval_interval == 0):
                     self.forward_only(next(self.eval_data))
                     self.eval_batches_consumed += 1
@@ -442,7 +445,8 @@ class TrainLoop:
                     # output stays rank-gated in the logger sinks.
                     for cb in self.eval_callbacks:
                         cb(self)
-                if self.step % self.save_interval == 0:
+                if (self.save_interval > 0
+                        and self.step % self.save_interval == 0):
                     self.save(wait=False)  # write overlaps training
         finally:
             if self._profiling:  # run ended (or raised) inside the window:
@@ -452,7 +456,7 @@ class TrainLoop:
             # unwinding — a process exiting mid-commit can hang the other
             # hosts in orbax's finalization barrier
             self.wait_for_saves()
-        if self.step % self.save_interval != 0:
+        if self.save_interval <= 0 or self.step % self.save_interval != 0:
             self.save(wait=False)
         self.wait_for_saves()  # exit barrier: the last write must be durable
         self._prune()  # final retention pass over the finalized set

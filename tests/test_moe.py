@@ -173,3 +173,24 @@ def test_moe_pipe_loss_invariant_vs_pure_dp(tmp_path):
                        float(loop.run_step(batch)["loss"]))
     np.testing.assert_allclose(losses["dp"][0], losses["pp"][0], rtol=2e-5)
     np.testing.assert_allclose(losses["dp"][1], losses["pp"][1], rtol=2e-5)
+
+
+def test_moe_capacity_factor_plumbs_from_config():
+    """--moe_capacity_factor reaches the routing plan through the factory:
+    C = ceil(L/E * factor * top_k) on the named-blocks path, and the
+    train-schema default (1.25) stays the MoEMlp default."""
+    from distributed_pipeline_tpu.config.train import TrainSettings
+
+    assert TrainSettings().moe_capacity_factor == MoEMlp.capacity_factor
+    for cf, want_c in ((1.0, 8), (2.0, 16)):
+        wl = create_model_from_config(
+            model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+            num_layers=2, num_heads=2, dtype="float32", moe_experts=4,
+            moe_top_k=2, moe_every=2, moe_capacity_factor=cf)
+        params = wl.init_params(jax.random.PRNGKey(0))
+        batch = jax.tree_util.tree_map(jnp.asarray, wl.example_batch(2))
+        _, mvars = wl.model.apply(params, batch["input_ids"],
+                                  batch["pad_mask"],
+                                  mutable=["losses", "intermediates"])
+        dispatch = jax.tree_util.tree_leaves(mvars["intermediates"])[0]
+        assert dispatch.shape[-1] == want_c, (cf, dispatch.shape)

@@ -263,7 +263,7 @@ def test_launcher_pins_timestamp_across_attempts(monkeypatch):
     seen = []
 
     def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
-                  run_timestamp=None, log_dir=""):
+                  run_timestamp=None, log_dir="", log_tee=False):
         seen.append(run_timestamp)
         return 1 if len(seen) < 2 else 0  # fail once, then succeed
 

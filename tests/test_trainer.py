@@ -488,3 +488,17 @@ def test_resume_eval_stream_exact_with_changed_interval(tmp_path):
     meta = json.loads((tmp_path / "run" / "meta_000008.json").read_text())
     # resumed at 2 consumed + evals at steps 5,6,7,8 with interval 1
     assert meta["eval_batches_consumed"] == 6
+
+
+def test_zero_intervals_disable_periodic_actions(tmp_path):
+    """Interval <= 0 disables the periodic action instead of dying on the
+    modulo (the reference's loop would ZeroDivisionError); the final save
+    still runs so the run leaves a restorable checkpoint."""
+    import os
+
+    loop = make_loop(tmp_path, learning_steps=3, log_interval=0,
+                     save_interval=0)
+    loop.run_loop()
+    assert loop.step == 3
+    saved = sorted(d for d in os.listdir(tmp_path) if d.startswith("model_"))
+    assert saved == ["model_000003"]  # exit save only, no periodic saves
