@@ -11,8 +11,9 @@ for the method). Variants:
   moe-cf1.25     moe_mlp_fwd at the shipped defaults
   moe-cf1.0      capacity_factor 1.0 (no padding slots beyond K*L)
   moe-cf1.25-k1  top-1 routing (Switch), cf 1.25
-  moe-machinery  router + top-k + capacity cumsum + combine/dispatch
-                 build ONLY (no expert matmuls): the non-MXU overhead
+  moe-machinery  router + top-k + load-balance aux (F_sum/P_sum) +
+                 capacity cumsum + combine/dispatch build ONLY (no
+                 expert matmuls): the non-MXU overhead
   moe-bf16comb   fork of moe_mlp_fwd building the [B, L, E, C] combine
                  tensor in bf16 (halves its HBM footprint)
 
@@ -58,13 +59,15 @@ def chain_total(step, reps, *args):
 
 
 def make_params(key):
-    ks = jax.random.split(key, 4)
+    ks = jax.random.split(key, 5)
     init = lambda k, *s: jax.random.normal(k, s, jnp.float32) * 0.02
     return {
         "router": init(ks[0], D, E),
         "wi": init(ks[1], E, D, M), "wo": init(ks[2], E, M, D),
-        # dense anchor weights (same fan-in init)
-        "dwi": init(ks[3], D, M), "dwo": init(ks[3], M, D),
+        # dense anchor weights (same fan-in init, independent keys — dwi
+        # and dwo from one key would be transposes of the same draw,
+        # misleading any numerics comparison against the dense anchor)
+        "dwi": init(ks[3], D, M), "dwo": init(ks[4], M, D),
     }
 
 
@@ -83,7 +86,9 @@ def moe_fwd(mp, x, *, top_k, cf):
 
 def moe_machinery(mp, x, *, top_k, cf):
     """Everything except the expert matmuls: the routing/dispatch
-    overhead in isolation. Reimplements moe_mlp_fwd's plan build, then
+    overhead in isolation. Reimplements moe_mlp_fwd's plan build —
+    INCLUDING the Switch load-balance reductions (F_sum/P_sum/aux,
+    moe.py:154-165), which the real forward always pays — then
     contracts combine straight against x (one cheap einsum) so nothing
     is DCE'd."""
     import math
@@ -97,6 +102,12 @@ def moe_machinery(mp, x, *, top_k, cf):
         remaining = remaining * (1.0 - mask)
         gates.append((probs * mask).sum(-1))
         masks.append(mask)
+    # Switch aux loss statistics (moe_mlp_fwd computes these every call;
+    # no pad mask here, so the live count is just B*L)
+    n_live = jnp.asarray(B * L, jnp.float32)
+    F_sum = masks[0].sum(axis=(0, 1))
+    P_sum = probs.sum(axis=(0, 1))
+    aux = E * jnp.sum(F_sum / n_live * (P_sum / n_live))
     claims = jnp.stack(masks, axis=2).reshape(B, L * K, E)
     pos = jnp.cumsum(claims, axis=1) - claims
     keep_flat = claims * (pos < C)
@@ -110,8 +121,9 @@ def moe_machinery(mp, x, *, top_k, cf):
     for k, g in enumerate(gates):
         w = (g / denom)[..., None] * keep[:, :, k]
         combine = combine + w[..., None] * slot[:, :, k][:, :, None, :]
-    # consume the plan without the expert MLPs
-    return x + jnp.einsum("blec,bld->bld", combine.astype(x.dtype), x) * 1e-6
+    # consume the plan AND the aux statistics without the expert MLPs
+    return (x + jnp.einsum("blec,bld->bld", combine.astype(x.dtype), x) * 1e-6
+            + aux.astype(x.dtype) * 1e-30)
 
 
 def moe_fwd_bf16comb(mp, x, *, top_k, cf):

@@ -1,28 +1,53 @@
 """Benchmark: training throughput on the available hardware, per BASELINE.md
-config shape.
+config shape — as a STREAMING, BUDGET-AWARE harness.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...,
-   "configs": [...per-shape results...]}
+Output contract (the driver parses stdout, humans watch stderr):
 
-The headline config is BASELINE.md's north star (DiffuSeq-base,
-seq_len=128, bf16) WITH the reference's default microbatch-64 gradient
-accumulation (ref config/train.py:11-12 — also the measured v5e optimum);
-the ``configs`` list covers the other single-chip-benchable BASELINE
-shapes: the same shape unaccumulated (pure config-2 semantics),
-DiffuSeq-large @ seq 512 with and without rematerialization (config 3
-shape), and GPT-2-medium (config 4). The reference publishes no absolute
-numbers (BASELINE.md), so ``vs_baseline`` reports achieved MFU / the 40%
-MFU target from /root/repo/BASELINE.json.
+* stdout carries ONE machine-readable JSON line, printed at the end of every
+  run — including budget-truncated ones:
+    {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...,
+     "configs": [...per-leg results, with {"name": ..., "skipped": "budget"}
+     markers for legs the wall-clock budget dropped...]}
+* every completed leg is ALSO (a) appended immediately to a JSONL artifact
+  (``BENCH_ARTIFACT``, default ``bench_legs.jsonl``) and (b) echoed to stderr
+  as it finishes — so a timeout can no longer destroy the whole run's signal
+  (the r5 failure mode: rc=124 after 12 legs of work, zero numbers captured).
+
+Budget: ``BENCH_BUDGET_S`` (seconds, default 600 — sized to sit inside the
+driver's timeout). The headline leg always runs; before each later leg the
+elapsed wall clock is checked and remaining legs are skipped with explicit
+markers once the budget is spent. Legs run headline-first so a truncated run
+always contains the north star.
+
+Compile cost is first-class: a persistent XLA compilation cache
+(``BENCH_CACHE_DIR``, default ``model_checkpoints/bench/compile_cache``,
+persistent across rounds) makes repeat runs near-compile-free, and every
+train leg reports its compile-vs-steady-state split (``compile_s``,
+``first_step_s`` vs the steady timed window).
+
+The headline config is BASELINE.md's north star (DiffuSeq-base, seq_len=128,
+bf16) WITH the reference's default microbatch-64 gradient accumulation (ref
+config/train.py:11-12 — also the measured v5e optimum); the ``configs`` list
+covers the other single-chip-benchable BASELINE shapes plus the
+exceeds-feature legs (MoE, scan_layers, long-context flash, KV-cache decode).
+The reference publishes no absolute numbers (BASELINE.md), so ``vs_baseline``
+reports achieved MFU / the 40% MFU target from /root/repo/BASELINE.json.
 """
 
 from __future__ import annotations
 
+import functools
 import json
+import os
+import sys
 import time
 
 
 def main() -> None:
+    t_bench0 = time.perf_counter()
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "600"))
+    artifact_path = os.environ.get("BENCH_ARTIFACT", "bench_legs.jsonl")
+
     import jax
 
     from distributed_pipeline_tpu.utils import logger
@@ -34,10 +59,20 @@ def main() -> None:
     from distributed_pipeline_tpu.models import create_model_from_config
     from distributed_pipeline_tpu.parallel import make_mesh
     from distributed_pipeline_tpu.utils.perf import (
+        enable_persistent_compilation_cache,
         mfu,
         transformer_train_flops_per_token,
     )
     from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    # Persistent compilation cache, stable across bench invocations AND
+    # rounds: leg k of run n+1 reuses leg k of run n's XLA compile.
+    cache_dir = enable_persistent_compilation_cache(
+        os.environ.get("BENCH_CACHE_DIR", "auto"),
+        run_dir="model_checkpoints/bench")
+    if cache_dir:
+        print(f"# compilation cache: {cache_dir}", file=sys.stderr,
+              flush=True)
 
     on_tpu = jax.default_backend() == "tpu"
     dtype = "bfloat16" if on_tpu else "float32"
@@ -49,15 +84,12 @@ def main() -> None:
                 moe_experts: int = 0, moe_top_k: int = 2,
                 moe_capacity_factor: float = 1.25,
                 scan_layers: bool = False):
-        """tokens/sec for one config; warmup step compiles, then a timed
-        window. ``batch`` is PER HOST (reference trainer.py:89 semantics:
-        global = batch x hosts); a tuple tries sizes left-to-right and falls
-        back on HBM OOM (the driver runs this unattended — a too-ambitious
-        batch must degrade, not abort the whole bench)."""
-        import os
-        if os.environ.get("BENCH_ONLY") and \
-                os.environ["BENCH_ONLY"] not in name:
-            return None  # iteration filter: BENCH_ONLY=<substring>
+        """tokens/sec for one config; the first step is timed separately
+        (compile + dispatch) from the steady-state window. ``batch`` is PER
+        HOST (reference trainer.py:89 semantics: global = batch x hosts); a
+        tuple tries sizes left-to-right and falls back on HBM OOM (the
+        driver runs this unattended — a too-ambitious batch must degrade,
+        not abort the whole bench)."""
         if isinstance(batch, tuple):
             for i, b in enumerate(batch):
                 try:
@@ -73,7 +105,6 @@ def main() -> None:
                     if i == len(batch) - 1:
                         raise
                     # stderr: stdout is the ONE machine-readable JSON line
-                    import sys
                     print(f"# {name}: batch {b} failed ({type(e).__name__}); "
                           f"retrying with {batch[i + 1]}", file=sys.stderr,
                           flush=True)
@@ -98,10 +129,18 @@ def main() -> None:
                          ema_rate="0.9999", learning_steps=0,
                          log_interval=10 ** 9, save_interval=10 ** 9,
                          mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0)
-        # Warmup: compile + fill the loader prefetch queues + let dispatch
-        # pipeline to depth — a cold 1-step warmup undermeasures steady
-        # state by ~10% (62.3% -> 68.8% MFU on the v5e headline).
-        for _ in range(8 if on_tpu else 1):
+        # First step paid separately: with the AOT step (utils/trainer.py)
+        # its wall time is compile + dispatch + one step, and
+        # loop.compile_time_s isolates the lower()/compile() share — the
+        # number the persistent cache collapses on warm runs.
+        t0 = time.perf_counter()
+        m = loop.run_step(next(loop.data))
+        float(jax.device_get(m["loss"]))
+        first_step_s = time.perf_counter() - t0
+        # Warmup: fill the loader prefetch queues + let dispatch pipeline
+        # to depth — a cold 1-step warmup undermeasures steady state by
+        # ~10% (62.3% -> 68.8% MFU on the v5e headline).
+        for _ in range(7 if on_tpu else 0):
             m = loop.run_step(next(loop.data))
         # device_get, not block_until_ready: the latter can UNDER-block
         # through a remote-accelerator tunnel (returns before the queue
@@ -145,6 +184,8 @@ def main() -> None:
             "n_params": loop.n_params,
             "batch": batch, "microbatch": microbatch or batch,
             "seq_len": seq_len, "remat": remat,
+            "compile_s": round(loop.compile_time_s or 0.0, 3),
+            "first_step_s": round(first_step_s, 3),
         }
 
     def measure_decode(name: str, *, gen_tokens: int, batch: int,
@@ -155,16 +196,10 @@ def main() -> None:
         gpt2_decode prefill + per-token path). Decode is latency-bound —
         each step is one [B, 1, D] forward against the cache — so the
         right scale is tokens/s, not MFU."""
-        import os
-
         import jax.numpy as jnp
         import numpy as np
 
         from distributed_pipeline_tpu.models.sampling import gpt2_decode
-
-        if os.environ.get("BENCH_ONLY") and \
-                os.environ["BENCH_ONLY"] not in name:
-            return None  # iteration filter: BENCH_ONLY=<substring>
 
         dims = dict(vocab_size=vocab) if on_tpu else dict(
             hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
@@ -177,8 +212,10 @@ def main() -> None:
             np.random.default_rng(0).integers(4, dims["vocab_size"],
                                               (batch, seq_len), np.int32))
         run = jax.jit(lambda p, i: gpt2_decode(wl, p, i, prompt_len))
+        t0 = time.perf_counter()
         out = run(params, ids)  # compile
         float(jax.device_get(out.sum().astype(jnp.float32)))  # full drain
+        compile_s = time.perf_counter() - t0
         reps = 3 if on_tpu else 1
         t0 = time.perf_counter()
         for _ in range(reps):
@@ -194,23 +231,29 @@ def main() -> None:
             "decode_tokens_per_sec_per_chip": round(tps, 1),
             "batch": batch, "gen_tokens": gen_tokens, "seq_len": seq_len,
             "prompt_len": prompt_len,
+            "compile_s": round(compile_s, 3),
         }
 
     # Per-chip batch sizes are the measured MFU sweet spots on v5e (base:
     # 64/128/256/512 sweep in r2; large/gpt2 sized to fit one chip's HBM
     # with the single-EMA bench loop); tiny on CPU so smoke runs finish.
     bsz = (lambda b: b if on_tpu else 4)
-    configs = [
+    # Legs are LAZY (name, thunk) pairs so the budget guard can drop a leg
+    # without paying its compile, ordered headline-first so a truncated run
+    # always contains the north star.
+    legs = [
         # Headline: BASELINE config 2/3 shape with the reference's DEFAULT
         # microbatch of 64 (ref config/train.py:11-12) — which the sweep
         # (16/32/64/128 at batch 256) also measures as the v5e throughput
         # optimum (76% MFU vs 68% unaccumulated: the scan's smaller
         # working set schedules better).
-        measure("diffuseq-base-seq128", family="diffuseq", size="base",
-                seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1),
+        ("diffuseq-base-seq128", functools.partial(
+            measure, "diffuseq-base-seq128", family="diffuseq", size="base",
+            seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1)),
         # no-accumulation variant (pure config-2 semantics)
-        measure("diffuseq-base-seq128-noaccum", family="diffuseq",
-                size="base", seq_len=128, batch=bsz(256)),
+        ("diffuseq-base-seq128-noaccum", functools.partial(
+            measure, "diffuseq-base-seq128-noaccum", family="diffuseq",
+            size="base", seq_len=128, batch=bsz(256))),
         # config 3 shape: large model, long sequence, +/- remat. Small
         # microbatches are the big lever at this scale (46% MFU at
         # batch=microbatch=32 -> 69.7% at batch 128/microbatch 4: the tiny
@@ -218,17 +261,20 @@ def main() -> None:
         # scan amortizes the optimizer/EMA); at these chunk sizes XLA's
         # dense attention beats the flash kernel, which "auto" already
         # picks below 1k context.
-        measure("diffuseq-large-seq512", family="diffuseq", size="large",
-                seq_len=512, batch=(bsz(128), bsz(32), bsz(8)),
-                microbatch=bsz(4)),
-        measure("diffuseq-large-seq512-remat", family="diffuseq",
-                size="large", seq_len=512, batch=(bsz(128), bsz(32), bsz(8)),
-                microbatch=bsz(8), remat=True),
+        ("diffuseq-large-seq512", functools.partial(
+            measure, "diffuseq-large-seq512", family="diffuseq",
+            size="large", seq_len=512, batch=(bsz(128), bsz(32), bsz(8)),
+            microbatch=bsz(4))),
+        ("diffuseq-large-seq512-remat", functools.partial(
+            measure, "diffuseq-large-seq512-remat", family="diffuseq",
+            size="large", seq_len=512, batch=(bsz(128), bsz(32), bsz(8)),
+            microbatch=bsz(8), remat=True)),
         # config 4: the causal-LM path (different xent/attention profile);
         # microbatch 32 is its measured optimum (74.8% vs 66.7% at 128).
-        measure("gpt2-medium-seq128", family="gpt2", size="medium",
-                seq_len=128, batch=(bsz(256), bsz(64), bsz(32)),
-                microbatch=bsz(32)),
+        ("gpt2-medium-seq128", functools.partial(
+            measure, "gpt2-medium-seq128", family="gpt2", size="medium",
+            seq_len=128, batch=(bsz(256), bsz(64), bsz(32)),
+            microbatch=bsz(32))),
         # Long context (exceeds the BASELINE shapes): the Pallas flash
         # kernel path — "auto" picks it on TPU from 1k context — at 4k,
         # where the dense [L, L] logits would dominate HBM traffic
@@ -239,55 +285,102 @@ def main() -> None:
         # microbatch 2 beats 1 and 4 at both lengths); 1024x1024 kernel
         # blocks + the diagonal-only causal masking lifted this shape
         # 41.5% -> 49.6% MFU (PARITY.md long-context section).
-        measure("gpt2-base-seq4096-flash", family="gpt2", size="base",
-                seq_len=4096 if on_tpu else 256,
-                batch=(bsz(64), bsz(16), bsz(4)), microbatch=bsz(2)),
+        ("gpt2-base-seq4096-flash", functools.partial(
+            measure, "gpt2-base-seq4096-flash", family="gpt2", size="base",
+            seq_len=4096 if on_tpu else 256,
+            batch=(bsz(64), bsz(16), bsz(4)), microbatch=bsz(2))),
         # Long-context curve extension: 8k context through the same flash
         # path (quadratic attention share doubles vs 4k).
-        measure("gpt2-base-seq8192-flash", family="gpt2", size="base",
-                seq_len=8192 if on_tpu else 256,
-                batch=(bsz(32), bsz(8), bsz(2)), microbatch=bsz(2)),
+        ("gpt2-base-seq8192-flash", functools.partial(
+            measure, "gpt2-base-seq8192-flash", family="gpt2", size="base",
+            seq_len=8192 if on_tpu else 256,
+            batch=(bsz(32), bsz(8), bsz(2)), microbatch=bsz(2))),
         # MoE: 8 experts top-2 in every 2nd block — measures the one-hot
         # dispatch/combine einsum cost on real hardware (MFU against
         # ACTIVE params: only top_k experts run per token).
-        measure("diffuseq-base-seq128-moe8", family="diffuseq", size="base",
-                seq_len=128, batch=(bsz(256), bsz(64)),
-                microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2),
+        ("diffuseq-base-seq128-moe8", functools.partial(
+            measure, "diffuseq-base-seq128-moe8", family="diffuseq",
+            size="base", seq_len=128, batch=(bsz(256), bsz(64)),
+            microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2)),
         # Same MoE at capacity_factor 1.0: zero padding slots (E*C == K*L).
         # artifacts/moe_gap.py decomposes the moe8 MFU gap — at cf 1.25 the
         # expert GEMMs pay ~2x the +25% slot flops (non-power-of-two row
         # tiling), at cf 1.0 they run at dense efficiency; the knob
         # (--moe_capacity_factor) trades overflow drops for throughput.
-        measure("diffuseq-base-seq128-moe8-cf1", family="diffuseq",
-                size="base", seq_len=128, batch=(bsz(256), bsz(64)),
-                microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2,
-                moe_capacity_factor=1.0),
+        ("diffuseq-base-seq128-moe8-cf1", functools.partial(
+            measure, "diffuseq-base-seq128-moe8-cf1", family="diffuseq",
+            size="base", seq_len=128, batch=(bsz(256), bsz(64)),
+            microbatch=bsz(256) // 4 or 1, moe_experts=8, moe_top_k=2,
+            moe_capacity_factor=1.0)),
         # scan_layers: the stacked-weights layer scan (one traced block) —
         # quantifies the compile-time-vs-MFU tradeoff PARITY.md documents,
         # in the driver signal.
-        measure("diffuseq-base-seq128-scan", family="diffuseq", size="base",
-                seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1,
-                scan_layers=True),
+        ("diffuseq-base-seq128-scan", functools.partial(
+            measure, "diffuseq-base-seq128-scan", family="diffuseq",
+            size="base", seq_len=128, batch=bsz(256),
+            microbatch=bsz(256) // 4 or 1, scan_layers=True)),
         # KV-cache decode throughput (generation, not training) at two
         # batch sizes — the pair anchors the batch-scaling curve (decode
         # is latency-bound per step, so tokens/s should scale near-
         # linearly with batch until the weight-streaming bandwidth wall).
-        measure_decode("gpt2-base-decode128", gen_tokens=128 if on_tpu else 8,
-                       batch=bsz(64), seq_len=1024 if on_tpu else 64),
-        measure_decode("gpt2-base-decode128-b8",
-                       gen_tokens=128 if on_tpu else 8,
-                       batch=8 if on_tpu else 2,
-                       seq_len=1024 if on_tpu else 64),
+        ("gpt2-base-decode128", functools.partial(
+            measure_decode, "gpt2-base-decode128",
+            gen_tokens=128 if on_tpu else 8,
+            batch=bsz(64), seq_len=1024 if on_tpu else 64)),
+        ("gpt2-base-decode128-b8", functools.partial(
+            measure_decode, "gpt2-base-decode128-b8",
+            gen_tokens=128 if on_tpu else 8,
+            batch=8 if on_tpu else 2,
+            seq_len=1024 if on_tpu else 64)),
     ]
 
-    configs = [c for c in configs if c is not None]  # BENCH_ONLY filter
-    import os
     only = os.environ.get("BENCH_ONLY", "")
-    # The headline contract holds only for a FULL run (configs[0] is the
+    if only:  # iteration filter: BENCH_ONLY=<substring>
+        legs = [(n, f) for n, f in legs if only in n]
+
+    # Fresh artifact per run (a crash mid-run leaves the completed prefix).
+    if artifact_path:
+        open(artifact_path, "w").close()
+
+    configs = []
+
+    def emit(row: dict) -> None:
+        """Record one leg NOW: final-JSON list + JSONL artifact + stderr.
+        A later timeout/crash can only lose legs that never finished."""
+        configs.append(row)
+        if artifact_path:
+            with open(artifact_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        print(f"# leg {json.dumps(row)} [t+"
+              f"{time.perf_counter() - t_bench0:.0f}s]", file=sys.stderr,
+              flush=True)
+
+    for i, (name, thunk) in enumerate(legs):
+        elapsed = time.perf_counter() - t_bench0
+        # The HEADLINE leg (first in the list) is exempt: a bench run that
+        # reports nothing is strictly worse than one that overruns a little,
+        # and the final JSON's `value` is this leg.
+        if i > 0 and elapsed > budget_s:
+            emit({"name": name, "skipped": "budget"})
+            continue
+        try:
+            emit(thunk())
+        except Exception as e:
+            # One leg must not sink the others (or the final JSON line).
+            emit({"name": name,
+                  "error": f"{type(e).__name__}: {e}"[:500]})
+
+    # The headline contract holds only for a FULL leg list (legs[0] is the
     # DiffuSeq north star). Under BENCH_ONLY (iteration mode) the first
     # surviving train config — if any — is reported under its own name,
-    # never as the north star.
-    head = next((c for c in configs if "mfu" in c), None)
+    # never as the north star. In a full run the headline value must come
+    # from the headline LEG specifically: if that leg errored, report null
+    # (its error row stays in configs) rather than silently promoting the
+    # next leg's numbers under the north-star label.
+    if only:
+        head = next((c for c in configs if "mfu" in c), None)
+    else:
+        head = configs[0] if configs and "mfu" in configs[0] else None
     if only and head is not None:
         metric = (f"tokens/sec/chip ({head['name']} [BENCH_ONLY={only}], "
                   f"{jax.devices()[0].device_kind})")
@@ -302,6 +395,9 @@ def main() -> None:
         "mfu": head["mfu"] if head else None,
         "n_params": head["n_params"] if head else None,
         "n_devices": jax.device_count(),
+        "budget_s": budget_s,
+        "elapsed_s": round(time.perf_counter() - t_bench0, 1),
+        "compilation_cache": cache_dir,
         "configs": configs,
     }))
 

@@ -34,6 +34,7 @@ __all__ = [
     "ArgparseCompatibleBaseModel",
     "S",
     "Setting",
+    "Validator",
     "choice",
     "C",
     "item",
@@ -213,6 +214,11 @@ class ArgparseCompatibleBaseModel(BaseModel):
 # Short aliases, matching the reference's exports (base.py:82-87).
 S = ArgparseCompatibleBaseModel
 Setting = ArgparseCompatibleBaseModel
+
+# Reference exports a ``Validator`` alias (base.py:80) so user settings
+# classes can declare field validators without importing pydantic
+# themselves; pydantic v2's field_validator is the equivalent surface.
+Validator = pydantic.field_validator
 
 T = TypeVar("T")
 

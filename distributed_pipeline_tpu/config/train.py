@@ -54,6 +54,12 @@ class GeneralSettings(S):
                                          "eval decoding (diffuseq only)")
     profile_dir: str = _("", "capture a jax.profiler trace of a few steps "
                              "into this directory (TensorBoard format)")
+    compilation_cache_dir: str = _(
+        "auto", "persistent XLA compilation-cache directory: 'auto' = "
+                "<run_dir>/compile_cache (restarts/resumes of the run "
+                "recompile nothing), 'off' disables, else an explicit dir "
+                "shared across runs; exported to spawned workers as "
+                "JAX_COMPILATION_CACHE_DIR")
 
 
 class DataSettings(S):

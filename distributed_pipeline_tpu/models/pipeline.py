@@ -395,7 +395,7 @@ class MoEScanBlocks(nn.Module):
         pure-DP run over the same global batch, independent of the
         chunking. fsdp/tensor/expert/sequence axes are rejected by
         moe_stacked_specs (v1 composes {data, pipe} only)."""
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         pspec = moe_stacked_specs(mesh, lp)
@@ -827,7 +827,7 @@ class PipelinedBlocks(nn.Module):
 
     def _pipe_step(self, mesh, S, lp, x, ck, cv, live, idx):
         """One decode token through the pipe ring (docstring above)."""
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         pspec, gather, tp = stacked_specs(mesh, lp)
@@ -894,7 +894,7 @@ class PipelinedBlocks(nn.Module):
                  if EMBED in axes}
 
     def _gpipe(self, mesh, S, lp, x, pad_mask, collect_kv: bool = False):
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         seq = mesh.shape["sequence"] > 1

@@ -165,7 +165,7 @@ def pipelined_loss(mesh, lp, rest, diff, aux, scalars, *, pp_chunks: int,
     over, not differentiated; integer ids/masks and mask-derived
     denominators qualify).
     """
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     S = mesh.shape["pipe"]

@@ -137,10 +137,42 @@ def _tee_pump(proc, sink, prefix: str):
     return t
 
 
+def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
+                run_timestamp: Optional[str] = None,
+                cache_dir: str = "") -> dict:
+    """Environment for spawned worker ``i`` — the ring coordinates plus the
+    persistent-compilation-cache propagation: every worker (and every
+    restart attempt) points at the SAME cache dir, so only the first ring
+    member to reach a given computation pays its XLA compile; siblings and
+    respawned attempts hit the on-disk cache."""
+    env = dict(os.environ)
+    if run_timestamp:
+        env["DPT_RUN_TIMESTAMP"] = run_timestamp
+    if cache_dir:
+        env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    env.update({
+        AUTORUN_ENV_FLAG: "1",
+        "JAX_COORDINATOR_ADDRESS": coord,
+        "JAX_NUM_PROCESSES": str(nprocs),
+        "JAX_PROCESS_INDEX": str(i),
+        "JAX_PLATFORMS": "cpu",
+        # Disable any site-installed remote-accelerator plugin for
+        # dev-mode CPU workers (a registered plugin may override the
+        # platform selection and grab single-tenant hardware).
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": (env_flags := env.get("XLA_FLAGS", ""))
+        + (" " if env_flags else "")
+        + f"--xla_force_host_platform_device_count="
+          f"{devices_per_proc}",
+    })
+    return env
+
+
 def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      monitor_interval: float,
                      run_timestamp: Optional[str] = None,
-                     log_dir: str = "", log_tee: bool = False) -> int:
+                     log_dir: str = "", log_tee: bool = False,
+                     cache_dir: str = "") -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -170,24 +202,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     codes: List[Optional[int]] = []
     try:
         for i in range(nprocs):
-            env = dict(os.environ)
-            if run_timestamp:
-                env["DPT_RUN_TIMESTAMP"] = run_timestamp
-            env.update({
-                AUTORUN_ENV_FLAG: "1",
-                "JAX_COORDINATOR_ADDRESS": coord,
-                "JAX_NUM_PROCESSES": str(nprocs),
-                "JAX_PROCESS_INDEX": str(i),
-                "JAX_PLATFORMS": "cpu",
-                # Disable any site-installed remote-accelerator plugin for
-                # dev-mode CPU workers (a registered plugin may override the
-                # platform selection and grab single-tenant hardware).
-                "PALLAS_AXON_POOL_IPS": "",
-                "XLA_FLAGS": (env_flags := env.get("XLA_FLAGS", ""))
-                + (" " if env_flags else "")
-                + f"--xla_force_host_platform_device_count="
-                  f"{devices_per_proc}",
-            })
+            env = _worker_env(i, nprocs, coord, devices_per_proc,
+                              run_timestamp, cache_dir)
             if log_dir:
                 # append: a restarted ring continues the same files (the
                 # attempt boundary is visible from the launcher's own log)
@@ -249,7 +265,8 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             nprocs: int, devices_per_proc: int = 2,
                             max_restarts: int = 0,
                             monitor_interval: float = 0.2,
-                            log_dir: str = "", log_tee: bool = False) -> int:
+                            log_dir: str = "", log_tee: bool = False,
+                            cache_dir: Optional[str] = None) -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
@@ -275,11 +292,21 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     import time
     run_timestamp = os.environ.get("DPT_RUN_TIMESTAMP") or time.strftime(
         "%Y%m%d-%H%M%S")
+    # Compilation-cache propagation: an explicit cache_dir (or one already
+    # exported by enable_persistent_compilation_cache in this process) is
+    # shipped to every worker of every attempt, so ring restarts — the
+    # elastic-recovery path — resume without paying the model compile again.
+    # (Workers running run/train.py with the default '--compilation_cache_dir
+    # auto' additionally converge on <run_dir>/compile_cache by themselves,
+    # since DPT_RUN_TIMESTAMP pins one shared run dir.)
+    if cache_dir is None:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
     attempt = 0
     while True:
         code = _run_worker_ring(cmd_base, nprocs, devices_per_proc,
                                 monitor_interval, run_timestamp,
-                                log_dir=log_dir, log_tee=log_tee)
+                                log_dir=log_dir, log_tee=log_tee,
+                                cache_dir=cache_dir)
         if code == 0 or attempt >= max_restarts:
             return code
         attempt += 1

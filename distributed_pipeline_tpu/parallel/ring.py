@@ -168,7 +168,7 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     sharding batch over (data, fsdp), heads over tensor, sequence over the
     ring axis."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
 
     mesh = mesh if mesh is not None else current_mesh()
     if mesh is None:

@@ -1,0 +1,29 @@
+"""Version bridges for the JAX surface this package targets.
+
+The code is written against the current stable JAX API (``jax.shard_map``
+with ``check_vma=``); some deployment images pin an older jax (0.4.x) where
+shard_map still lives in ``jax.experimental.shard_map`` and the kwarg is
+``check_rep=``. Importing ``shard_map`` from here gives every call site one
+spelling that works on both — the alternative (per-site try/except and kwarg
+probing) would smear version logic across five modules.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map  # jax >= 0.6: the stable top-level export
+except ImportError:  # pragma: no cover - exercised only on old-jax images
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, /, *args, **kwargs):  # type: ignore[no-redef]
+        # jax 0.4.x spells the replication/varying-manual-axes check
+        # ``check_rep``; the semantics match what callers mean by
+        # ``check_vma`` here (all call sites pass False).
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)

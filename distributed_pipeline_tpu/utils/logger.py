@@ -36,7 +36,8 @@ from typing import Any, Dict, IO, Iterable, List, Optional, Sequence, Union
 
 __all__ = [
     "DEBUG", "INFO", "WARN", "ERROR", "DISABLED",
-    "logkv", "logkv_mean", "logkvs", "logkvs_mean", "dumpkvs", "getkvs",
+    "logkv", "logkv_mean", "logkv_sum", "logkvs", "logkvs_mean", "dumpkvs",
+    "getkvs",
     "log", "debug", "info", "warn", "error",
     "set_level", "get_dir", "record_tabular", "dump_tabular",
     "profile_kv", "profile", "configure", "reset", "scoped_configure",
@@ -279,6 +280,14 @@ def logkv(key: str, val: Any) -> None:
 def logkv_mean(key: str, val: Any) -> None:
     """Log a value averaged over all calls between dumps (running mean)."""
     get_current().logkv_mean(key, val)
+
+
+def logkv_sum(key: str, val: Any) -> None:
+    """Accumulate a SUM over all calls between dumps (profile_kv semantics,
+    exposed as a first-class call): right for additive costs like
+    ``compile_time_s``, where several recompiles inside one log window
+    should add up, not average away."""
+    get_current().name2val[key] += val
 
 
 def logkvs(d: Dict[str, Any]) -> None:

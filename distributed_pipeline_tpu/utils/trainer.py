@@ -31,6 +31,7 @@ of the reference scaffold finds the same control surface.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 
 import jax
@@ -51,7 +52,7 @@ from ..parallel.sharding import (
 )
 from . import checkpoint as ckpt_lib
 from . import logger
-from .perf import StepTimer, device_peak_flops, mfu, \
+from .perf import AOTStep, StepTimer, device_peak_flops, mfu, \
     transformer_train_flops_per_token
 
 __all__ = ["TrainLoop", "TrainState", "update_ema"]
@@ -113,6 +114,10 @@ class TrainLoop:
         keep_checkpoints: int = 0,
         eval_batches_consumed: int = 0,
     ) -> None:
+        # Time-to-signal accounting starts at construction: everything up
+        # to the end of the first optimizer step (state init, restore,
+        # tracing, XLA compile, dispatch) is setup the user waits through.
+        self._construct_t0 = time.perf_counter()
         self.workload = model
         self.data = data
         self.eval_data = eval_data
@@ -161,8 +166,18 @@ class TrainLoop:
                 f"divisible by data x fsdp x expert mesh axes = {dpf}")
         self._base_rng = jax.random.PRNGKey(seed)
 
+        # AOT compile metrics (perf.AOTStep): total seconds spent in
+        # lower()/compile() and construction->first-optimizer-step wall time.
+        # None until the first step so a zero can't masquerade as "free".
+        self.compile_time_s: Optional[float] = None
+        self.time_to_first_step_s: Optional[float] = None
+
         self._build_state(resume_checkpoint)
         self._build_step_fns()
+
+        # Cumulative sample count via the get_batch_length hook; seeded from
+        # the resumed step so the gauge is continuous across restarts.
+        self._samples = self.step * self.global_batch
 
         tokens_per_step = self.global_batch * self.workload.seq_len
         self._timer = StepTimer(tokens_per_step)
@@ -216,6 +231,7 @@ class TrainLoop:
         oshard = optax.tree_map_params(
             self.opt, lambda _, s: s, abstract_opt, pshard,
             transform_non_params=lambda _: rep)
+        self._oshard = oshard
 
         with self.mesh:
             params = jax.jit(
@@ -241,10 +257,26 @@ class TrainLoop:
         )
         if restored is not None:
             self.step = restored["step"]
-            params = restored["params"]
-            ema = restored["ema"] or ema
+            # One-time defensive copy: the jitted train step DONATES the
+            # whole TrainState, and donating orbax-restored buffers directly
+            # is unsafe when the executable came from the persistent
+            # compilation cache (jaxlib 0.4.37 CPU: reproducible heap
+            # corruption — "malloc(): smallbin double linked list
+            # corrupted" — in the resume-with-warm-cache path). Copying
+            # hands the step exclusively-owned buffers; sharding is
+            # preserved (restore targeted the live shardings). Peak memory
+            # stays at the pre-copy ~2x state: the fresh-init tree is
+            # dropped BEFORE each copy and the restored source is popped so
+            # it frees as soon as its copy materializes.
+            own = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            del params
+            params = own(restored.pop("params"))
+            if restored["ema"]:
+                del ema
+                ema = own(restored.pop("ema"))
             if restored["opt_state"] is not None:
-                opt_state = restored["opt_state"]
+                del opt_state
+                opt_state = own(restored.pop("opt_state"))
             logger.info(f"resumed from step {self.step} "
                         f"({self.checkpoint_dir or resume_checkpoint})")
 
@@ -342,14 +374,46 @@ class TrainLoop:
             _, metrics = micro_scan(params, batch, rng, with_grad=False)
             return metrics
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
-        self._eval_step = jax.jit(eval_step)
+        # Explicit AOT lower()/compile() instead of dispatch-time jit: the
+        # first run_step/forward_only triggers a TIMED compile, surfaced as
+        # the compile_time_s / time_to_first_step_s metrics (perf.AOTStep).
+        # With the persistent compilation cache enabled (run/train.py,
+        # bench.py) a warm restart's compile_time_s collapses to the cache
+        # lookup, and the split makes that visible instead of folding it
+        # into the first step's wall time.
+        #
+        # out_shardings pins the output state to the INPUT state's layout
+        # (params/mu/nu/EMA shard alike — the FSDP contract from
+        # _build_state). Without it GSPMD may emit outputs with drifted
+        # specs (e.g. a small bias's mu replicated instead of fsdp-sharded),
+        # and the AOT executable — unlike dispatch jit, which would silently
+        # recompile a second variant for step 2's new input shardings —
+        # rejects the mismatch. Pinning gives step-stable shardings AND
+        # kills that hidden double compile. Metrics are scalars: replicated.
+        rep = replicated(self.mesh)
+        state_shard = TrainState(step=rep, params=pshard,
+                                 opt_state=self._oshard,
+                                 ema={r: pshard for r in rates})
+        self._train_step = AOTStep(
+            jax.jit(train_step, donate_argnums=(0,),
+                    out_shardings=(state_shard, rep)), "train_step",
+            on_compile=self._note_compile)
+        self._eval_step = AOTStep(jax.jit(eval_step, out_shardings=rep),
+                                  "eval_step",
+                                  on_compile=self._note_compile)
         # Sequence-parallel meshes shard the batch's L axis too, so each chip
         # only ever holds its L/n activation slice (ring attention does the
         # cross-shard interaction).
         self._batch_sharding = batch_shardings(
             self.mesh, microbatched=True,
             seq_sharded=self.mesh.shape["sequence"] > 1)
+
+    def _note_compile(self, name: str, seconds: float) -> None:
+        """AOTStep callback: accumulate and log compile time (summed across
+        step functions and recompiles within a log window)."""
+        self.compile_time_s = (self.compile_time_s or 0.0) + seconds
+        logger.logkv_sum("compile_time_s", round(seconds, 3))
+        logger.info(f"compiled {name} in {seconds:.2f}s")
 
     # ------------------------------------------------------------- data prep
 
@@ -363,12 +427,30 @@ class TrainLoop:
 
     # ------------------------------------------------------------- the loop
 
+    def get_batch_length(self, batch: Dict[str, np.ndarray]) -> int:
+        """Number of examples in a host batch — the reference's user hook
+        (trainer.py:33-43) for custom batch structures; the default reads
+        the first leaf's leading dim. Feeds the cumulative ``samples``
+        gauge, so subclasses with exotic batches (nested, ragged-marker,
+        dict-of-dicts) override ONE method instead of the loop."""
+        return int(len(jax.tree_util.tree_leaves(batch)[0]))
+
     def run_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """One optimizer step (reference run_step, trainer.py:198-201)."""
+        first = self.time_to_first_step_s is None
         with self.mesh:
             self.state, metrics = self._train_step(self.state,
                                                    self._prepare(batch))
+        if first:
+            # Block once so "time to first step" means a COMPLETED step
+            # (async dispatch would otherwise stop the clock at enqueue).
+            jax.block_until_ready(metrics["loss"])
+            self.time_to_first_step_s = (time.perf_counter()
+                                         - self._construct_t0)
+            logger.logkv("time_to_first_step_s",
+                         round(self.time_to_first_step_s, 3))
         self.step += 1
+        self._samples += self.get_batch_length(batch) * jax.process_count()
         self._timer.tick()
         logger.logkvs_mean(metrics)
         self.log_step()
@@ -387,9 +469,11 @@ class TrainLoop:
         return metrics
 
     def log_step(self) -> None:
-        """step + cumulative samples (reference log_step trainer.py:273-275)."""
+        """step + cumulative samples (reference log_step trainer.py:273-275);
+        samples accumulate through the get_batch_length hook (equals
+        ``step * global_batch`` unless a subclass overrides it)."""
         logger.logkv("step", self.step)
-        logger.logkv("samples", self.step * self.global_batch)
+        logger.logkv("samples", self._samples)
 
     def _log_throughput(self) -> None:
         sps, tps = self._timer.lap()

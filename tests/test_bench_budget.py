@@ -1,0 +1,250 @@
+"""Time-to-signal contracts: the streaming budget-aware bench, the
+persistent-compilation-cache wiring, and the trainer's AOT compile metrics.
+
+The r5 postmortem (VERDICT.md weak #1-2): bench.py printed its single JSON
+line only at the very end, so a driver timeout captured ZERO of the twelve
+legs' work. These tests pin the replacement contract — headline-first leg
+order, incremental JSONL persistence, budget-skip markers that still yield a
+parseable final line — and the compile-cache path that makes warm runs
+near-compile-free.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from distributed_pipeline_tpu.config.train import TrainSettings
+from distributed_pipeline_tpu.parallel import make_mesh
+from distributed_pipeline_tpu.parallel.launcher import _worker_env
+from distributed_pipeline_tpu.utils.perf import (
+    AOTStep,
+    enable_persistent_compilation_cache,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ bench harness
+
+@pytest.fixture(scope="module")
+def bench_run(tmp_path_factory):
+    """One constrained-budget bench subprocess, shared by the contract
+    tests: BENCH_BUDGET_S=1 forces every leg after the headline to be
+    budget-skipped (the headline is exempt by contract)."""
+    tmp = tmp_path_factory.mktemp("bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "1",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        # headline + 4 satellites of the same family: enough legs to
+        # observe ordering and skipping without a multi-minute test
+        "BENCH_ONLY": "diffuseq-base-seq128",
+    })
+    # The conftest's 8-fake-device XLA_FLAGS would leak into the subprocess
+    # and change the bench's dp=-1 mesh; the bench contract is about the
+    # default single-device CPU environment.
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=420)
+    return proc, tmp / "legs.jsonl"
+
+
+def test_bench_budget_exits_zero_with_parseable_json(bench_run):
+    proc, _ = bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert final["configs"], final
+    assert final["budget_s"] == 1.0
+
+
+def test_bench_headline_leg_completes_first(bench_run):
+    proc, _ = bench_run
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    head = final["configs"][0]
+    # The headline leg is exempt from the budget guard: it carries real
+    # numbers (and the compile/steady split) even when the budget is blown
+    # before it finishes.
+    assert head["name"] == "diffuseq-base-seq128"
+    assert "skipped" not in head and "error" not in head
+    assert head["tokens_per_sec_per_chip"] > 0
+    assert head["compile_s"] > 0
+    assert head["first_step_s"] >= head["compile_s"]
+    assert final["value"] == head["tokens_per_sec_per_chip"]
+
+
+def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
+    proc, _ = bench_run
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    skipped = [c for c in final["configs"] if c.get("skipped") == "budget"]
+    assert skipped, "1s budget must skip every non-headline leg"
+    assert all(set(c) == {"name", "skipped"} for c in skipped)
+    # every leg is accounted for: completed or explicitly skipped
+    assert len(final["configs"]) == 5
+
+
+def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
+    proc, artifact = bench_run
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    rows = [json.loads(line) for line in
+            artifact.read_text().strip().splitlines()]
+    # the incrementally-persisted artifact IS the final configs list — a
+    # timeout after leg k would still have left rows 0..k on disk
+    assert rows == final["configs"]
+
+
+# ------------------------------------------------ compilation-cache wiring
+
+def test_compilation_cache_flag_roundtrips_through_settings():
+    s = TrainSettings.from_argv(["--compilation_cache_dir", "/tmp/cc"])
+    assert s.compilation_cache_dir == "/tmp/cc"
+    assert TrainSettings().compilation_cache_dir == "auto"
+    # and through the JSON path (the --config_json workflow)
+    s2 = TrainSettings.model_validate(json.loads(s.to_json()))
+    assert s2.compilation_cache_dir == "/tmp/cc"
+
+
+def test_enable_persistent_cache_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    assert enable_persistent_compilation_cache("off") == ""
+    assert enable_persistent_compilation_cache("auto", run_dir="") == ""
+    try:
+        d = enable_persistent_compilation_cache("auto",
+                                                run_dir=str(tmp_path))
+        assert d == os.path.join(str(tmp_path), "compile_cache")
+        assert os.path.isdir(d)
+        # exported so spawned workers inherit the same cache
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == d
+    finally:
+        # "off" resets jax's once-only cache object too — leaving it
+        # initialized would pin this tmp dir for the whole test process
+        enable_persistent_compilation_cache("off")
+
+
+def test_cache_dir_reaches_worker_env(tmp_path):
+    env = _worker_env(1, 2, "127.0.0.1:9999", 2, run_timestamp="20260803",
+                      cache_dir=str(tmp_path))
+    assert env["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path)
+    assert env["JAX_PROCESS_INDEX"] == "1"
+    assert env["DPT_RUN_TIMESTAMP"] == "20260803"
+
+
+def test_launcher_forwards_cache_env_to_ring(monkeypatch, tmp_path):
+    from distributed_pipeline_tpu.parallel import launcher
+
+    seen = {}
+
+    def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
+                  run_timestamp=None, log_dir="", log_tee=False,
+                  cache_dir=""):
+        seen["cache_dir"] = cache_dir
+        return 0
+
+    monkeypatch.setattr(launcher, "_run_worker_ring", fake_ring)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+    assert launcher.run_argv_as_distributed("mod", [], nprocs=2) == 0
+    assert seen["cache_dir"] == str(tmp_path)
+
+
+# ------------------------------------------------- AOT compile-time metrics
+
+def _tiny_loop(tmp_path, tag):
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=2, num_heads=2, dtype="float32")
+    data = load_data_from_args("train", batch_size=8, dataset="synthetic-lm",
+                               seq_len=16, vocab_size=64, seed=0)
+    return TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                     learning_steps=100, log_interval=10 ** 9,
+                     save_interval=10 ** 9, mesh=make_mesh(dp=8),
+                     checkpoint_dir=str(tmp_path / tag), seed=5)
+
+
+def test_aot_compile_metrics_and_cache_hit_path(tmp_path, monkeypatch):
+    """compile_time_s/time_to_first_step_s are populated by the first step,
+    and a RESUMED TrainLoop under a warm persistent cache compiles
+    measurably faster — the exact elastic-restart path the cache exists
+    for. The resume leg doubles as a regression test for donating
+    orbax-restored buffers into a cache-deserialized executable (jaxlib
+    0.4.37 CPU heap corruption; trainer copies restored trees)."""
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    try:
+        enable_persistent_compilation_cache(str(tmp_path / "cache"))
+
+        cold = _tiny_loop(tmp_path, "run")
+        assert cold.compile_time_s is None  # nothing compiled at build time
+        cold.run_step(next(cold.data))
+        assert cold.compile_time_s > 0
+        assert cold.time_to_first_step_s >= cold.compile_time_s
+        assert os.listdir(str(tmp_path / "cache")), \
+            "persistent cache wrote nothing"
+        cold.save()
+
+        warm = _tiny_loop(tmp_path, "run")  # same dir: auto-resumes
+        assert warm.step == 1
+        warm.run_step(next(warm.data))
+        warm.run_step(next(warm.data))  # steady state past the restore
+        # The XLA compile is the dominant share of the cold number; a cache
+        # hit replaces it with a disk read. 0.7 leaves headroom for the
+        # (uncached) trace+lower share while still failing if the cache
+        # silently stopped hitting.
+        assert warm.compile_time_s < cold.compile_time_s * 0.7, (
+            warm.compile_time_s, cold.compile_time_s)
+    finally:
+        enable_persistent_compilation_cache("off")
+
+
+def test_aot_step_recompiles_on_shape_change():
+    calls = []
+    step = AOTStep(jax.jit(lambda x: x * 2), "mul",
+                   on_compile=lambda n, s: calls.append((n, s)))
+    import jax.numpy as jnp
+    a = step(jnp.ones((4,)))
+    b = step(jnp.ones((4,)))          # same shape: no recompile
+    assert len(calls) == 1
+    c = step(jnp.ones((8,)))          # shape change: falls back to recompile
+    assert len(calls) == 2
+    assert float(a.sum()) == 8 and float(b.sum()) == 8
+    assert float(c.sum()) == 16
+    assert step.compile_time_s == pytest.approx(sum(s for _, s in calls))
+
+
+def test_get_batch_length_hook_feeds_samples(tmp_path):
+    """The reference's get_batch_length user hook: overriding it changes the
+    cumulative ``samples`` gauge without touching the loop."""
+    import numpy as np
+
+    from distributed_pipeline_tpu.utils import logger
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    class HalfCounted(TrainLoop):
+        def get_batch_length(self, batch):
+            return super().get_batch_length(batch) // 2
+
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=2, num_heads=2, dtype="float32")
+    data = load_data_from_args("train", batch_size=8, dataset="synthetic-lm",
+                               seq_len=16, vocab_size=64, seed=0)
+    loop = HalfCounted(model=wl, data=data, batch_size=8, lr=1e-3,
+                       learning_steps=100, log_interval=10 ** 9,
+                       save_interval=10 ** 9, mesh=make_mesh(dp=8),
+                       checkpoint_dir=str(tmp_path), seed=5)
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_step(next(loop.data))
+        loop.run_step(next(loop.data))
+        kvs = logger.getkvs()
+    assert kvs["samples"] == 2 * (8 // 2)  # hook value, not step*batch
+    assert loop.get_batch_length(next(loop.data)) == 4

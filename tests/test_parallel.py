@@ -216,6 +216,7 @@ def _run_train_child(tmp_path, extra, timeout=420):
     return out
 
 
+@pytest.mark.slow  # heaviest tier: compile-dominated / multi-loop composition (VERDICT r5 weak #3)
 def test_multiprocess_end_to_end_training(tmp_path):
     """VERDICT r1 #4: real TrainLoop steps over a 2-process loopback ring —
     per-host batches assembled into global arrays
@@ -263,7 +264,8 @@ def test_launcher_pins_timestamp_across_attempts(monkeypatch):
     seen = []
 
     def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
-                  run_timestamp=None, log_dir="", log_tee=False):
+                  run_timestamp=None, log_dir="", log_tee=False,
+                  cache_dir=""):
         seen.append(run_timestamp)
         return 1 if len(seen) < 2 else 0  # fail once, then succeed
 
@@ -275,6 +277,7 @@ def test_launcher_pins_timestamp_across_attempts(monkeypatch):
     assert "DPT_RUN_TIMESTAMP" not in os.environ  # no process-global leak
 
 
+@pytest.mark.slow  # heaviest tier: compile-dominated / multi-loop composition (VERDICT r5 weak #3)
 def test_launcher_restart_supervision_resumes_past_checkpoint(tmp_path):
     """VERDICT r1 #6: SIGKILL a worker mid-run; with --max_restarts the
     launcher respawns the ring and checkpoint auto-resume continues the job
@@ -295,6 +298,7 @@ def test_launcher_restart_supervision_resumes_past_checkpoint(tmp_path):
     assert (tmp_path / "model_000006").is_dir()
 
 
+@pytest.mark.slow  # heaviest tier: compile-dominated / multi-loop composition (VERDICT r5 weak #3)
 def test_multiprocess_decode_callback(tmp_path):
     """The eval-decode callback jits over globally-sharded params, so EVERY
     process must join it (code-review r3 finding): a 2-process ring runs the

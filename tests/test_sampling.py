@@ -67,6 +67,7 @@ def test_diffuseq_sample_preserves_source_and_shapes():
     assert int(pred.min()) >= 0 and int(pred.max()) < VOCAB
 
 
+@pytest.mark.slow  # heaviest tier: compile-dominated / multi-loop composition (VERDICT r5 weak #3)
 def test_diffuseq_decode_beats_chance_after_training(tmp_path):
     """~400 steps on the deterministic synthetic mapping must put target-span
     token accuracy well above chance (1/VOCAB ~ 3%); longer training drives
