@@ -124,33 +124,49 @@ def main() -> None:
                                    seq_len=seq_len,
                                    vocab_size=dims["vocab_size"], seed=0,
                                    num_loader_proc=2)
+        # sanitize=True: the runtime half of graftlint — every leg row
+        # carries the OBSERVED XLA compile count, so a recompile
+        # regression (e.g. an unpinned sharding re-triggering step-2
+        # compiles, the r6 bug class) shows up in BENCH artifacts as
+        # recompile_count growth instead of a silent throughput dip.
         loop = TrainLoop(model=wl, data=data, batch_size=batch,
                          microbatch=microbatch or batch, lr=1e-4,
                          ema_rate="0.9999", learning_steps=0,
                          log_interval=10 ** 9, save_interval=10 ** 9,
-                         mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0)
+                         mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0,
+                         sanitize=True)
         # First step paid separately: with the AOT step (utils/trainer.py)
         # its wall time is compile + dispatch + one step, and
         # loop.compile_time_s isolates the lower()/compile() share — the
         # number the persistent cache collapses on warm runs.
-        t0 = time.perf_counter()
-        m = loop.run_step(next(loop.data))
-        float(jax.device_get(m["loss"]))
-        first_step_s = time.perf_counter() - t0
-        # Warmup: fill the loader prefetch queues + let dispatch pipeline
-        # to depth — a cold 1-step warmup undermeasures steady state by
-        # ~10% (62.3% -> 68.8% MFU on the v5e headline).
-        for _ in range(7 if on_tpu else 0):
+        # try/finally: a leg that dies mid-measure (the HBM-OOM retry path
+        # and the per-leg error rows both swallow exceptions) must still
+        # detach its monitor — otherwise every failed attempt leaves one
+        # more handler on the 'jax' logger and jax_log_compiles stuck on.
+        # (A TrainLoop that dies during CONSTRUCTION detaches its own
+        # monitor — see TrainLoop.__init__ — so the retry loop above is
+        # covered too.)
+        try:
+            t0 = time.perf_counter()
             m = loop.run_step(next(loop.data))
-        # device_get, not block_until_ready: the latter can UNDER-block
-        # through a remote-accelerator tunnel (returns before the queue
-        # drains), inflating throughput by whatever was still in flight.
-        float(jax.device_get(m["loss"]))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            m = loop.run_step(next(loop.data))
-        float(jax.device_get(m["loss"]))
-        dt = time.perf_counter() - t0
+            float(jax.device_get(m["loss"]))
+            first_step_s = time.perf_counter() - t0
+            # Warmup: fill the loader prefetch queues + let dispatch
+            # pipeline to depth — a cold 1-step warmup undermeasures steady
+            # state by ~10% (62.3% -> 68.8% MFU on the v5e headline).
+            for _ in range(7 if on_tpu else 0):
+                m = loop.run_step(next(loop.data))
+            # device_get, not block_until_ready: the latter can UNDER-block
+            # through a remote-accelerator tunnel (returns before the queue
+            # drains), inflating throughput by whatever was still in flight.
+            float(jax.device_get(m["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                m = loop.run_step(next(loop.data))
+            float(jax.device_get(m["loss"]))
+            dt = time.perf_counter() - t0
+        finally:
+            recompiles = loop.stop_sanitizer()
         tps = steps * batch * seq_len * jax.process_count() / dt
         # MFU against ACTIVE params: a top-k routed MoE block only runs
         # top_k of its moe_experts expert MLPs per token, so counting every
@@ -186,6 +202,12 @@ def main() -> None:
             "seq_len": seq_len, "remat": remat,
             "compile_s": round(loop.compile_time_s or 0.0, 3),
             "first_step_s": round(first_step_s, 3),
+            "time_to_first_step_s": round(loop.time_to_first_step_s or 0.0,
+                                          3),
+            # total XLA compiles for the WHOLE leg (init + train step +
+            # steady window): steady-state growth here is a regression
+            # even when tokens/sec still looks plausible
+            "recompile_count": recompiles,
         }
 
     def measure_decode(name: str, *, gen_tokens: int, batch: int,

@@ -1,0 +1,33 @@
+"""graftlint: JAX-aware static analysis for this training stack.
+
+Generic linters cannot see the bug classes that actually burn TPU runs
+here — the ones past rounds fixed by hand (CHANGES.md r6): PRNG key
+reuse (artifacts/moe_gap.py), a hidden step-2 recompile from unpinned
+``out_shardings``, donating Orbax-restored buffers into a
+cache-deserialized executable. This subpackage is the correctness-
+tooling layer production JAX stacks carry for exactly these hazards:
+
+* :mod:`core` — AST module model (import resolution, traced-context
+  discovery, donation map), the rule registry, and the file runner.
+* :mod:`rules` — the rule catalog (GL001..GL006), one visitor per
+  hazard class this repo has hit.
+* :mod:`baseline` — committed allowlist store: findings audited as
+  unavoidable are fingerprinted into ``graftlint_baseline.json``
+  instead of the rule being suppressed.
+* :mod:`cli` — ``python -m distributed_pipeline_tpu.analysis
+  [--format json|human] [--baseline FILE] PATHS``.
+
+The static pass is paired with a runtime "sanitizer mode"
+(``--sanitize``, utils/perf.RecompileMonitor + transfer guards in
+utils/trainer.TrainLoop) that catches dynamically what the AST pass
+cannot prove: actual recompiles and implicit host<->device transfers.
+"""
+
+from __future__ import annotations
+
+from .baseline import Baseline
+from .core import Finding, Module, Rule, all_rules, run_paths
+from . import rules as _rules  # noqa: F401  (imports register the catalog)
+
+__all__ = ["Finding", "Module", "Rule", "Baseline", "all_rules",
+           "run_paths"]

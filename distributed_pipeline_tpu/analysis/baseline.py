@@ -1,0 +1,112 @@
+"""Committed allowlist for audited findings.
+
+A finding the team has audited and judged unavoidable (e.g. a ``float()``
+on a rate STRING inside a traced step — safe, but indistinguishable
+statically from a device sync) goes into ``graftlint_baseline.json``
+instead of the rule being weakened for everyone. Entries match by the
+Finding fingerprint — rule + path tail + stripped source line + an
+occurrence index — so the baseline survives line-number churn but
+invalidates itself when the flagged line actually changes.
+
+Discovery: an explicit ``--baseline FILE`` wins; ``auto`` (the default)
+looks for ``graftlint_baseline.json`` in the current directory, then up
+the parents of the first linted path — so ``python -m
+distributed_pipeline_tpu.analysis distributed_pipeline_tpu/`` run from
+the repo root gates against the committed file with zero flags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Finding
+
+__all__ = ["Baseline", "discover_baseline", "path_tail", "BASELINE_NAME"]
+
+BASELINE_NAME = "graftlint_baseline.json"
+
+
+def path_tail(path: str) -> str:
+    """The last two path components — the same normalization Finding
+    fingerprints use, so entry paths compare stably across cwds."""
+    return "/".join(path.replace(os.sep, "/").split("/")[-2:])
+
+
+def discover_baseline(first_path: Optional[str]) -> Optional[str]:
+    candidates = [os.path.join(os.getcwd(), BASELINE_NAME)]
+    if first_path:
+        cur = os.path.dirname(os.path.abspath(first_path))
+        for _ in range(16):
+            candidates.append(os.path.join(cur, BASELINE_NAME))
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    return None
+
+
+class Baseline:
+    """Fingerprint set with enough sidecar detail (path/line/snippet/
+    audit note) that a human can re-audit an entry without re-running
+    the tool against the old tree."""
+
+    def __init__(self, entries: Optional[List[Dict]] = None,
+                 path: Optional[str] = None) -> None:
+        self.entries: List[Dict] = entries or []
+        self.path = path
+        self._fps = {e["fingerprint"] for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if not isinstance(data, dict) or "entries" not in data:
+            raise ValueError(f"{path}: not a graftlint baseline "
+                             "(expected {'version': 1, 'entries': [...]})")
+        return cls(list(data["entries"]), path=path)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding],
+                      notes: Optional[Dict[str, str]] = None) -> "Baseline":
+        notes = notes or {}
+        entries = []
+        for f in findings:
+            e = f.to_dict()
+            e.pop("col", None)
+            e.pop("message", None)
+            if f.fingerprint in notes:
+                e["audit"] = notes[f.fingerprint]
+            entries.append(e)
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        payload = {
+            "version": 1,
+            "tool": "graftlint",
+            "note": ("audited-unavoidable findings; regenerate with "
+                     "`python -m distributed_pipeline_tpu.analysis "
+                     "--write-baseline <paths>` and re-audit the diff"),
+            "entries": sorted(self.entries,
+                              key=lambda e: (e["path"], e.get("line", 0),
+                                             e["rule"])),
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+        self.path = path
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.fingerprint in self._fps
+
+    def split(self, findings: Iterable[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, baselined) partition preserving order."""
+        new, old = [], []
+        for f in findings:
+            (old if f in self else new).append(f)
+        return new, old

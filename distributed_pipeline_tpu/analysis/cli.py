@@ -1,0 +1,146 @@
+"""graftlint CLI.
+
+    python -m distributed_pipeline_tpu.analysis [options] PATHS...
+
+Exit codes: 0 = clean against the baseline, 1 = findings outside the
+baseline (CI fails), 2 = usage error. stdout carries the report in the
+selected format (``json`` is a single object — machine-parseable, the
+contract tests/test_analysis.py pins); notes go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .baseline import BASELINE_NAME, Baseline, discover_baseline, path_tail
+from .core import all_rules, iter_py_files, run_paths
+from . import rules as _rules  # noqa: F401  (register the catalog)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_pipeline_tpu.analysis",
+        description="graftlint: JAX-aware static analysis "
+                    "(PRNG reuse, host syncs, donation, purity, "
+                    "recompiles, compat bypasses)")
+    p.add_argument("paths", nargs="*", metavar="PATH",
+                   help="files or directories to lint")
+    p.add_argument("--format", choices=("human", "json"), default="human",
+                   help="report format (default: human)")
+    p.add_argument("--baseline", default="auto", metavar="FILE",
+                   help=f"baseline file; 'auto' (default) discovers "
+                        f"{BASELINE_NAME} in cwd or above the first PATH; "
+                        f"'none' disables")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write ALL current findings to the baseline file "
+                        "and exit 0 (then audit the diff before committing)")
+    p.add_argument("--rules", default="", metavar="CODES",
+                   help="comma-separated rule-code prefixes to run "
+                        "(default: all), e.g. GL001,GL004")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.code}: {r.description}")
+        return 0
+    if not args.paths:
+        print("error: no paths given (see --help)", file=sys.stderr)
+        return 2
+    if args.rules:
+        wanted = [w.strip() for w in args.rules.split(",") if w.strip()]
+        rules = [r for r in rules
+                 if any(r.code.startswith(w) for w in wanted)]
+        if not rules:
+            print(f"error: no rules match {args.rules!r}", file=sys.stderr)
+            return 2
+
+    findings, n_files = run_paths(args.paths, rules)
+    if n_files == 0:
+        # a gate that lints zero files vouches for nothing — a typo'd CI
+        # path must fail loudly, not report OK
+        print(f"error: no .py files found under {args.paths!r}",
+              file=sys.stderr)
+        return 2
+
+    baseline_path: Optional[str] = None
+    if args.baseline == "auto":
+        baseline_path = discover_baseline(args.paths[0])
+    elif args.baseline not in ("none", ""):
+        baseline_path = args.baseline
+
+    if args.write_baseline:
+        path = baseline_path or BASELINE_NAME
+        notes = {}
+        old_entries = []
+        if baseline_path:
+            try:  # carry audit notes forward across regenerations
+                old_entries = Baseline.load(baseline_path).entries
+                notes = {e["fingerprint"]: e["audit"]
+                         for e in old_entries if "audit" in e}
+            except (OSError, ValueError, KeyError):
+                old_entries = []
+        # MERGE, don't clobber: a narrowed run (--rules filter, or a
+        # PATHS subset of what the baseline covers) must not silently
+        # drop the audited entries it didn't re-lint. An old entry is
+        # replaced only when this run actually re-covered it — its file
+        # was visited AND its rule was selected; everything else is
+        # preserved verbatim (stale entries in gated paths are caught by
+        # the no-stale-entries CI test, not by losing them here).
+        visited = {path_tail(p) for p in iter_py_files(args.paths)}
+        selected = {r.code for r in rules} | {"GL000-parse-error"}
+        preserved = [e for e in old_entries
+                     if path_tail(e["path"]) not in visited
+                     or e["rule"] not in selected]
+        new_bl = Baseline.from_findings(findings, notes)
+        new_bl.entries = preserved + new_bl.entries
+        new_bl.save(path)
+        print(f"wrote {len(findings)} finding(s) "
+              + (f"(+{len(preserved)} preserved out-of-scope entr"
+                 f"{'y' if len(preserved) == 1 else 'ies'}) "
+                 if preserved else "")
+              + f"to {path}; audit the diff before committing",
+              file=sys.stderr)
+        return 0
+
+    baseline = None
+    if baseline_path:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, baselined = (findings, []) if baseline is None \
+        else baseline.split(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "tool": "graftlint",
+            "checked_files": n_files,
+            "rules": [r.code for r in rules],
+            "baseline": baseline_path,
+            "baselined": len(baselined),
+            "findings": [f.to_dict() for f in new],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+            if f.snippet:
+                print(f"    {f.snippet}")
+        tail = (f"{n_files} file(s), {len(new)} finding(s)"
+                + (f", {len(baselined)} baselined" if baselined else "")
+                + (f" [baseline: {baseline_path}]" if baseline_path else ""))
+        print(("FAIL " if new else "OK ") + tail,
+              file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0

@@ -1,0 +1,355 @@
+"""graftlint engine: module model, traced-context discovery, registry.
+
+The rules in :mod:`rules` need three module-level facts that plain
+``ast.walk`` does not give them:
+
+1. **What a dotted name means** (``Imports``): ``jnp.sum`` must resolve
+   to ``jax.numpy.sum`` whatever the import spelling, including relative
+   imports (``from ..utils.jax_compat import shard_map``).
+2. **Which code is traced** (``Module.traced`` / ``in_traced``): host
+   syncs and side effects are only hazards inside code JAX traces — a
+   function jitted directly (decorator or ``jax.jit(f)`` call), a
+   ``lax.scan``/``fori_loop``/``while_loop``/``shard_map`` body, or
+   anything lexically nested in one. Tracedness is deliberately NOT
+   propagated through ordinary calls: that keeps the pass precise (a
+   helper also called from eager code would otherwise drown the report
+   in maybes; the runtime sanitizer covers the dynamic remainder).
+3. **Which callables donate** (``Module.donations``): call sites of a
+   binding built from ``jax.jit(f, donate_argnums=...)`` — directly,
+   through a wrapper call like ``AOTStep(jax.jit(...))``, or a
+   ``@partial(jax.jit, donate_argnums=...)`` decorator.
+
+Findings carry a line-number-independent ``fingerprint`` (rule + the
+last two path components + the stripped source line + an occurrence
+index) so a committed baseline survives unrelated edits to the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple, Type
+
+__all__ = ["Finding", "Imports", "Module", "Rule", "register",
+           "all_rules", "run_paths", "dotted"]
+
+# Wrappers whose function argument (or decorated function) is traced.
+TRACE_WRAPPERS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.named_call", "jax.eval_shape",
+    "nn.jit", "flax.linen.jit",
+}
+# callable -> positions of traced function arguments
+TRACED_ARG_POS = {
+    "jax.lax.scan": (0,), "jax.lax.map": (0,), "jax.lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1), "jax.lax.cond": (1, 2),
+    "jax.lax.associative_scan": (0,),
+}
+# any resolved name ending in one of these is a shard_map-style wrapper
+TRACED_ARG_SUFFIXES = {"shard_map": (0,)}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str
+    index: int = 0  # occurrence disambiguator among identical snippets
+
+    @property
+    def fingerprint(self) -> str:
+        tail = "/".join(self.path.replace(os.sep, "/").split("/")[-2:])
+        raw = "|".join([self.rule, tail, self.snippet, str(self.index)])
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet, "fingerprint": self.fingerprint}
+
+
+def dotted(node: ast.AST, imports: "Imports") -> Optional[str]:
+    """Resolve a Name/Attribute chain to a dotted path through the import
+    aliases, e.g. ``jnp.sum`` -> ``jax.numpy.sum``; None for anything
+    rooted in a non-name expression (calls, subscripts, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(imports.alias.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+class Imports:
+    """local name -> dotted origin, from the module's import statements."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.alias: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.alias[a.asname] = a.name
+                    else:
+                        # ``import jax.numpy`` binds ``jax``
+                        root = a.name.split(".")[0]
+                        self.alias[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                mod = "." * node.level + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.alias[a.asname or a.name] = f"{mod}.{a.name}"
+
+
+class Module:
+    """One parsed file plus the shared semantic maps rules consume."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self.lines = source.splitlines()
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.imports = Imports(self.tree)
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+        self.traced: set = self._find_traced()
+        # binding text -> donated positional indices; jitted_bindings is the
+        # superset (any binding known to hold a jitted callable)
+        self.donations: Dict[str, Tuple[int, ...]] = {}
+        self.jitted_bindings: set = set()
+        self._find_jit_bindings()
+
+    # ---------------------------------------------------------- tracedness
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        return dotted(node, self.imports)
+
+    def _wrapper_name(self, node: ast.AST) -> Optional[str]:
+        """Resolved name of a trace wrapper: ``jax.jit`` itself or
+        ``partial(jax.jit, ...)``."""
+        name = self.resolve(node)
+        if name in TRACE_WRAPPERS:
+            return name
+        if isinstance(node, ast.Call) and node.args:
+            fn = self.resolve(node.func)
+            if fn in ("functools.partial", "partial"):
+                inner = self.resolve(node.args[0])
+                if inner in TRACE_WRAPPERS:
+                    return inner
+        return None
+
+    def _mark(self, node: Optional[ast.AST], traced: set) -> None:
+        if isinstance(node, ast.Lambda):
+            traced.add(node)
+        elif isinstance(node, ast.Name):
+            for d in self.defs_by_name.get(node.id, ()):
+                traced.add(d)
+
+    def _find_traced(self) -> set:
+        traced: set = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    base = dec.func if isinstance(dec, ast.Call) else dec
+                    if (self._wrapper_name(dec) is not None
+                            or self._wrapper_name(base) is not None):
+                        traced.add(node)
+            elif isinstance(node, ast.Call):
+                fn = self.resolve(node.func)
+                if self._wrapper_name(node.func) is not None and node.args:
+                    self._mark(node.args[0], traced)
+                positions: Tuple[int, ...] = ()
+                if fn in TRACED_ARG_POS:
+                    positions = TRACED_ARG_POS[fn]
+                elif fn is not None:
+                    for suffix, pos in TRACED_ARG_SUFFIXES.items():
+                        if fn.split(".")[-1] == suffix:
+                            positions = pos
+                for p in positions:
+                    if p < len(node.args):
+                        self._mark(node.args[p], traced)
+        return traced
+
+    def in_traced(self, node: ast.AST) -> bool:
+        """True when ``node`` sits lexically inside a traced function."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self.traced:
+                return True
+            cur = self.parent.get(cur)
+        return False
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            cur = self.parent.get(cur)
+        return cur
+
+    # ------------------------------------------------------- donation map
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                try:
+                    val = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    return ()
+                if isinstance(val, int):
+                    return (val,)
+                try:
+                    return tuple(int(v) for v in val)
+                except (TypeError, ValueError):
+                    return ()
+        return ()
+
+    def _binding_target(self, call: ast.Call) -> Optional[str]:
+        """Source text of the Name/Attribute this call's result is bound
+        to — directly or through ONE wrapping call (``AOTStep(jit(...))``)."""
+        node: ast.AST = call
+        parent = self.parent.get(node)
+        if isinstance(parent, ast.Call) and node in parent.args:
+            node, parent = parent, self.parent.get(parent)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            tgt = parent.targets[0]
+            if isinstance(tgt, (ast.Name, ast.Attribute)):
+                return ast.unparse(tgt)
+        return None
+
+    def _find_jit_bindings(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                if self._wrapper_name(node.func) != "jax.jit":
+                    continue
+                target = self._binding_target(node)
+                if target is None:
+                    continue
+                self.jitted_bindings.add(target)
+                pos = self._donated_positions(node)
+                if pos:
+                    self.donations[target] = pos
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if (self._wrapper_name(dec) == "jax.jit"
+                            or (isinstance(dec, ast.Call) and
+                                self._wrapper_name(dec.func) == "jax.jit")):
+                        self.jitted_bindings.add(node.name)
+                        if isinstance(dec, ast.Call):
+                            pos = self._donated_positions(dec)
+                            if pos:
+                                self.donations[node.name] = pos
+
+    # ------------------------------------------------------------ helpers
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule.code, path=self.path, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message, snippet=self.snippet(line))
+
+
+class Rule:
+    """One hazard class. Subclasses set ``code``/``description`` and
+    implement ``check`` yielding findings for a module."""
+
+    code: str = ""
+    description: str = ""
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def run(self, module: Module) -> List[Finding]:
+        try:
+            return list(self.check(module))
+        except RecursionError:  # pathological nesting: skip, don't crash
+            return []
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.code and cls.code not in _REGISTRY, cls
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for _, cls in sorted(_REGISTRY.items())]
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    seen = set()
+    for p in paths:
+        if os.path.isfile(p):
+            files: Iterable[str] = [p]
+        else:
+            files = (os.path.join(root, f)
+                     for root, dirs, names in os.walk(p)
+                     if "__pycache__" not in root
+                     for f in sorted(names) if f.endswith(".py"))
+        for f in files:
+            key = os.path.abspath(f)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+
+def _assign_indices(findings: List[Finding]) -> List[Finding]:
+    """Stable occurrence indices so identical lines in one file get
+    distinct fingerprints (ordered by line so edits above shift nothing)."""
+    out: List[Finding] = []
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, f.snippet)
+        idx = counts.get(key, 0)
+        counts[key] = idx + 1
+        out.append(dataclasses.replace(f, index=idx))
+    return out
+
+
+def run_paths(paths: Iterable[str],
+              rules: Optional[List[Rule]] = None
+              ) -> Tuple[List[Finding], int]:
+    """Lint every .py under ``paths``; returns (findings, files_checked).
+    Unparseable files surface as ``parse-error`` findings (they gate —
+    code the analyzer cannot read is code nothing can vouch for)."""
+    rules = rules if rules is not None else all_rules()
+    findings: List[Finding] = []
+    n = 0
+    for path in iter_py_files(paths):
+        n += 1
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            module = Module(path, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                rule="GL000-parse-error", path=path,
+                line=getattr(e, "lineno", None) or 1, col=1,
+                message=f"could not parse: {e}", snippet=""))
+            continue
+        for rule in rules:
+            findings.extend(rule.run(module))
+    return _assign_indices(findings), n

@@ -1,0 +1,576 @@
+"""The graftlint rule catalog — one rule per hazard class this repo has
+actually hit (ISSUE 4 / CHANGES.md r6), each with the precision posture
+of a CI gate: prefer missing a hazard over crying wolf, because every
+finding either blocks a merge or must be audited into the baseline.
+
+GL001 key-reuse            same PRNG key consumed twice / used after split
+GL002 host-sync            .item()/float()/np.* on values inside traced code
+GL003 donation-after-use   a donated argument read after the donating call
+GL004 impure-jit           print/logkv/global/attr mutation under trace
+GL005 recompile-hazard     jit built per iteration; shape-derived scalars
+                           or f-strings flowing into jitted args
+GL006 raw-shard-map        jax.experimental.shard_map / check_rep= used
+                           directly instead of utils/jax_compat
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, Module, Rule, register
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+
+def _calls_in(node: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes in an expression/statement, NOT descending into nested
+    function definitions (those are separate scopes/contexts)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    if isinstance(node, ast.Call):
+        yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNC_NODES):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _shallow_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Nodes belonging to THIS statement only: header expressions and
+    value subtrees, not nested statements (a flattened walk visits those
+    on their own) and not nested function bodies."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, ast.stmt) or isinstance(c, _FUNC_NODES):
+                continue
+            stack.append(c)
+
+
+# --------------------------------------------------------------------- GL001
+
+
+class _KeyState:
+    __slots__ = ("uses", "split", "from_param")
+
+    def __init__(self, from_param: bool = False):
+        self.uses = 0
+        self.split = False
+        self.from_param = from_param
+
+    def copy(self) -> "_KeyState":
+        st = _KeyState(self.from_param)
+        st.uses, st.split = self.uses, self.split
+        return st
+
+
+# jax.random members that DERIVE keys rather than consuming entropy
+_KEY_DERIVERS = {"PRNGKey", "key", "fold_in", "key_data", "wrap_key_data",
+                 "clone", "key_impl"}
+# callables through which passing a key is not a (countable) consumption
+_KEY_TRANSPARENT = {"jax.eval_shape", "jax.device_put", "jax.tree_util.tree_map",
+                    "jax.tree.map", "jax.block_until_ready", "len", "print",
+                    "isinstance", "type", "repr", "str", "jax.ShapeDtypeStruct"}
+_KEY_PARAM_PAT = ("rng", "key", "prng", "seed_key")
+
+
+def _is_key_param(name: str) -> bool:
+    low = name.lower()
+    return any(low == p or low.endswith("_" + p) or low.startswith(p + "_")
+               or low.rstrip("0123456789") == p for p in _KEY_PARAM_PAT)
+
+
+@register
+class KeyReuse(Rule):
+    """GL001: the same PRNG key consumed by two samplers, consumed after
+    ``jax.random.split``, or consumed inside a loop without per-iteration
+    rebinding — all three produce silently correlated randomness (the
+    artifacts/moe_gap.py class of bug fixed by hand in r6)."""
+
+    code = "GL001-key-reuse"
+    description = ("PRNG key reused: each key must reach exactly one "
+                   "consumer; derive fresh keys with split/fold_in")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        self._out: List[Finding] = []
+        self._mod = module
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                state: Dict[str, _KeyState] = {}
+                args = node.args
+                for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                    if _is_key_param(a.arg):
+                        state[a.arg] = _KeyState(from_param=True)
+                self._walk(node.body, state, loop_events=None)
+        yield from self._out
+
+    # -- state machinery
+
+    def _report(self, node: ast.AST, msg: str) -> None:
+        self._out.append(self._mod.finding(self, node, msg))
+
+    def _consume_calls(self, stmt: ast.AST, state: Dict[str, _KeyState],
+                       loop_events: Optional[List[Tuple[str, str]]]) -> None:
+        for call in _calls_in(stmt):
+            fn = self._mod.resolve(call.func)
+            key_args = [a for a in list(call.args)
+                        + [k.value for k in call.keywords]
+                        if isinstance(a, ast.Name) and a.id in state]
+            if not key_args:
+                continue
+            if fn and fn.startswith("jax.random."):
+                member = fn.rsplit(".", 1)[1]
+                if member in _KEY_DERIVERS:
+                    continue
+                for a in key_args:
+                    st = state[a.id]
+                    if member == "split":
+                        if st.split:
+                            self._report(a, f"key '{a.id}' split twice — "
+                                            "each split consumes the key")
+                        elif st.uses:
+                            self._report(a, f"key '{a.id}' split after "
+                                            "already being consumed")
+                        st.split = True
+                    else:
+                        self._use(a, st, loop_events)
+            elif fn in _KEY_TRANSPARENT:
+                continue
+            else:
+                # arbitrary call: counts only for keys this scope derived
+                # itself (param-named heuristics would false-positive on
+                # non-key 'key' variables reaching helper calls)
+                for a in key_args:
+                    st = state[a.id]
+                    if not st.from_param:
+                        self._use(a, st, loop_events)
+
+    def _use(self, name_node: ast.Name, st: _KeyState,
+             loop_events: Optional[List[Tuple[str, str]]]) -> None:
+        if st.split:
+            self._report(name_node, f"key '{name_node.id}' used after "
+                                    "split — use one of the split results")
+        elif st.uses >= 1:
+            self._report(name_node, f"key '{name_node.id}' consumed more "
+                                    "than once — derive per-consumer keys "
+                                    "with jax.random.split/fold_in")
+        st.uses += 1
+        if loop_events is not None:
+            loop_events.append(("use", name_node.id))
+
+    def _rebind(self, target: ast.AST, value: Optional[ast.AST],
+                state: Dict[str, _KeyState],
+                loop_events: Optional[List[Tuple[str, str]]]) -> None:
+        fresh = False
+        if isinstance(value, ast.Call):
+            fn = self._mod.resolve(value.func)
+            if fn and fn.startswith("jax.random."):
+                # only key-DERIVING members produce keys; a sampler's
+                # output (jax.random.normal(...)) is data, not a key
+                member = fn.rsplit(".", 1)[1]
+                fresh = member in _KEY_DERIVERS or member == "split"
+        names: List[str] = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            names = [e.id for e in target.elts if isinstance(e, ast.Name)]
+        for n in names:
+            if fresh:
+                state[n] = _KeyState()
+                if loop_events is not None:
+                    loop_events.append(("rebind", n))
+            else:
+                state.pop(n, None)
+
+    def _walk(self, stmts: List[ast.stmt], state: Dict[str, _KeyState],
+              loop_events: Optional[List[Tuple[str, str]]]) -> None:
+        for s in stmts:
+            if isinstance(s, _FUNC_NODES[:2]) or isinstance(s, ast.ClassDef):
+                continue  # separate scope
+            if isinstance(s, ast.If):
+                self._consume_calls(s.test, state, loop_events)
+                branches = []
+                for body in (s.body, s.orelse):
+                    st = {k: v.copy() for k, v in state.items()}
+                    self._walk(body, st, loop_events)
+                    if not _terminates(body):
+                        branches.append(st)
+                self._merge(state, branches)
+            elif isinstance(s, _LOOP_NODES):
+                if isinstance(s, (ast.For, ast.AsyncFor)):
+                    self._consume_calls(s.iter, state, loop_events)
+                    self._rebind(s.target, None, state, loop_events)
+                else:
+                    self._consume_calls(s.test, state, loop_events)
+                pre = set(state)
+                events: List[Tuple[str, str]] = []
+                self._walk(s.body, state, events)
+                used = {n for kind, n in events if kind == "use"}
+                rebound = {n for kind, n in events if kind == "rebind"}
+                for n in sorted(used & pre - rebound):
+                    self._report(s, f"key '{n}' from outside the loop is "
+                                    "consumed every iteration without "
+                                    "rebinding (same randomness each pass)")
+                self._walk(s.orelse, state, loop_events)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    self._consume_calls(item.context_expr, state, loop_events)
+                self._walk(s.body, state, loop_events)
+            elif isinstance(s, ast.Try):
+                # try body on the live state (it's the path that runs);
+                # handlers/orelse on throwaway copies — consuming the whole
+                # Try subtree up front would double-count the body's uses
+                self._walk(s.body, state, loop_events)
+                for body in [h.body for h in s.handlers] + [s.orelse]:
+                    st = {k: v.copy() for k, v in state.items()}
+                    self._walk(body, st, None)
+                self._walk(s.finalbody, state, loop_events)
+            elif isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(s, "value", None)
+                if value is not None:
+                    self._consume_calls(value, state, loop_events)
+                targets = (s.targets if isinstance(s, ast.Assign)
+                           else [s.target])
+                for t in targets:
+                    self._rebind(t, value, state, loop_events)
+            else:
+                self._consume_calls(s, state, loop_events)
+
+    @staticmethod
+    def _merge(state: Dict[str, _KeyState],
+               branches: List[Dict[str, _KeyState]]) -> None:
+        if not branches:
+            return  # both branches terminated: keep pre-branch state
+        for name in list(state):
+            alive = [b[name] for b in branches if name in b]
+            if len(alive) < len(branches):
+                state.pop(name)  # rebound to a non-key somewhere
+                continue
+            st = state[name]
+            st.uses = max(b.uses for b in alive)
+            st.split = any(b.split for b in alive)
+        for b in branches:
+            for name, st in b.items():
+                if name not in state:
+                    state[name] = st.copy()
+
+
+# --------------------------------------------------------------------- GL002
+
+# numpy members that force (or silently constant-fold) a host round-trip
+# when handed a tracer; shape/constant builders (arange/zeros/linspace...)
+# stay legal — they consume static python values.
+_SYNC_NP = {"asarray", "array", "sum", "mean", "std", "var", "max", "min",
+            "argmax", "argmin", "any", "all", "allclose", "isnan",
+            "isfinite", "isinf", "where", "concatenate", "stack", "dot",
+            "matmul", "prod", "abs", "clip", "sqrt", "exp", "log",
+            "float32", "float64", "int32", "int64"}
+
+
+@register
+class HostSync(Rule):
+    """GL002: device->host synchronization inside traced code —
+    ``.item()``, ``float()/int()/bool()`` on non-literals, numpy ops, and
+    explicit ``device_get``/``block_until_ready`` all either fail at trace
+    time or (worse) silently freeze a traced value at trace time."""
+
+    code = "GL002-host-sync"
+    description = ("host sync inside jit/scan-traced code: .item(), "
+                   "float()/int(), np.*, device_get, block_until_ready")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not module.in_traced(node):
+                continue
+            func = node.func
+            fn = module.resolve(func)
+            if isinstance(func, ast.Attribute) and func.attr == "item" \
+                    and not node.args:
+                yield module.finding(self, node,
+                                     ".item() forces a device->host sync "
+                                     "(trace error under jit)")
+            elif isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                            "bool") \
+                    and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant):
+                yield module.finding(
+                    self, node,
+                    f"{func.id}() on a possibly-traced value blocks on the "
+                    "device (or freezes a trace-time constant); keep it a "
+                    "device scalar or hoist the conversion out of the "
+                    "traced function")
+            elif fn and fn.startswith("numpy.") \
+                    and fn.split(".")[-1] in _SYNC_NP:
+                yield module.finding(
+                    self, node,
+                    f"numpy call '{fn}' inside traced code syncs or "
+                    "constant-folds at trace time; use jax.numpy")
+            elif fn == "jax.device_get":
+                yield module.finding(self, node,
+                                     "jax.device_get inside traced code")
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr == "block_until_ready":
+                yield module.finding(self, node,
+                                     "block_until_ready inside traced code")
+
+
+# --------------------------------------------------------------------- GL003
+
+
+@register
+class DonationAfterUse(Rule):
+    """GL003: an argument donated to a jitted call is read afterwards.
+    The donated buffer is dead (or worse, aliased into the output — the
+    r6 heap-corruption class when combined with cache-deserialized
+    executables); every read after the donating call is a use of freed
+    memory the runtime may or may not catch."""
+
+    code = "GL003-donation-after-use"
+    description = ("argument donated via donate_argnums is read after "
+                   "the donating call")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.donations:
+            return
+        scopes: List[List[ast.stmt]] = [module.tree.body]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from self._scan_scope(module, body)
+
+    def _scan_scope(self, module: Module,
+                    body: List[ast.stmt]) -> Iterator[Finding]:
+        # linear source-order walk of the whole scope (branch-insensitive:
+        # donation sites are rare enough that simplicity wins)
+        stmts: List[ast.stmt] = []
+
+        def flatten(ss: List[ast.stmt]) -> None:
+            for s in ss:
+                if isinstance(s, _FUNC_NODES[:2]) or isinstance(s, ast.ClassDef):
+                    continue
+                stmts.append(s)
+                for field in ("body", "orelse", "finalbody"):
+                    flatten(getattr(s, field, []) or [])
+                for h in getattr(s, "handlers", []) or []:
+                    flatten(h.body)
+
+        flatten(body)
+        pending: Dict[str, ast.AST] = {}
+        for s in stmts:
+            live = {t for t in pending}
+            if live:
+                for n in _shallow_nodes(s):
+                    if not isinstance(n, (ast.Name, ast.Attribute)):
+                        continue
+                    if not isinstance(getattr(n, "ctx", None), ast.Load):
+                        continue
+                    text = ast.unparse(n)
+                    for donated in sorted(live):
+                        if text == donated or text.startswith(donated + "."):
+                            yield module.finding(
+                                self, n,
+                                f"'{donated}' was donated to a jitted call "
+                                "above — its buffer is dead; reading it is "
+                                "use-after-free (copy it first or use the "
+                                "call's result)")
+                            live.discard(donated)
+            # rebinds clear; new donations arm
+            targets: List[ast.AST] = []
+            if isinstance(s, ast.Assign):
+                targets = list(s.targets)
+            elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+                targets = [s.target]
+            target_texts: Set[str] = set()
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    if isinstance(e, (ast.Name, ast.Attribute)):
+                        target_texts.add(ast.unparse(e))
+            for call in (n for n in _shallow_nodes(s)
+                         if isinstance(n, ast.Call)):
+                try:
+                    callee = ast.unparse(call.func)
+                except Exception:  # pragma: no cover - defensive
+                    continue
+                positions = module.donations.get(callee)
+                if not positions:
+                    continue
+                for p in positions:
+                    if p < len(call.args) and isinstance(
+                            call.args[p], (ast.Name, ast.Attribute)):
+                        donated = ast.unparse(call.args[p])
+                        if donated not in target_texts:
+                            pending[donated] = call
+            for t in target_texts:
+                pending.pop(t, None)
+
+
+# --------------------------------------------------------------------- GL004
+
+
+@register
+class ImpureJit(Rule):
+    """GL004: side effects inside traced code run ONCE at trace time, not
+    per step — prints vanish, metrics log a single stale value, attribute
+    and global mutation desyncs from the compiled computation."""
+
+    code = "GL004-impure-jit"
+    description = ("side effect under jit/scan: print, logkv/logging, "
+                   "global/nonlocal, attribute mutation")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not module.in_traced(node):
+                continue
+            if isinstance(node, ast.Call):
+                func = node.func
+                fn = module.resolve(func)
+                if isinstance(func, ast.Name) and func.id == "print":
+                    yield module.finding(
+                        self, node, "print() under trace runs once at "
+                        "trace time — use jax.debug.print")
+                elif isinstance(func, ast.Attribute) \
+                        and func.attr.startswith("logkv"):
+                    yield module.finding(
+                        self, node, "metric logging under trace records a "
+                        "tracer once, not a value per step — log outside "
+                        "the jitted step")
+                elif fn and fn.startswith("logging."):
+                    yield module.finding(
+                        self, node, "logging call under trace runs once "
+                        "at trace time")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                yield module.finding(
+                    self, node, f"{type(node).__name__.lower()} statement "
+                    "under trace: mutation will not re-run per step")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        yield module.finding(
+                            self, t, f"attribute mutation "
+                            f"'{ast.unparse(t)} = ...' under trace happens "
+                            "once at trace time — return the value instead")
+
+
+# --------------------------------------------------------------------- GL005
+
+
+@register
+class RecompileHazard(Rule):
+    """GL005: patterns that defeat jit's compile cache — a fresh jit
+    wrapper built per loop iteration, and shape-derived Python scalars
+    (``len(x)``, ``x.shape``) or per-step-varying f-strings flowing into
+    a jitted call's traced arguments (each new value = a full retrace;
+    the r6 hidden step-2 recompile class)."""
+
+    code = "GL005-recompile-hazard"
+    description = ("recompile hazard: jit built inside a loop, or "
+                   "len()/.shape/f-string values passed to a jitted call")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if module._wrapper_name(node.func) == "jax.jit":
+                cur = module.parent.get(node)
+                while cur is not None and not isinstance(cur, _FUNC_NODES):
+                    if isinstance(cur, _LOOP_NODES):
+                        yield module.finding(
+                            self, node, "jax.jit called inside a loop "
+                            "builds a fresh wrapper (and cache entry) per "
+                            "iteration — hoist the jit out of the loop")
+                        break
+                    cur = module.parent.get(cur)
+                continue
+            try:
+                callee = ast.unparse(node.func)
+            except Exception:  # pragma: no cover - defensive
+                continue
+            if callee not in module.jitted_bindings:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                hazard = self._scalar_hazard(arg)
+                if hazard:
+                    yield module.finding(
+                        self, arg, f"{hazard} flows into jitted call "
+                        f"'{callee}' as a traced argument — every new "
+                        "value retraces and recompiles; mark it static "
+                        "(static_argnums) or derive it inside the jit")
+
+    @staticmethod
+    def _scalar_hazard(arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.JoinedStr):
+            return "an f-string (fresh object per call)"
+        for n in ast.walk(arg):
+            if isinstance(n, _FUNC_NODES):
+                return None
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len":
+                return "a len() python scalar"
+            if isinstance(n, ast.Attribute) and n.attr == "shape":
+                return "a .shape-derived python value"
+        return None
+
+
+# --------------------------------------------------------------------- GL006
+
+_COMPAT_EXEMPT = "utils/jax_compat.py"
+_RAW_SHARD_MAP = "jax.experimental.shard_map"
+
+
+@register
+class RawShardMap(Rule):
+    """GL006: shard_map imported/used from jax.experimental (or a raw
+    ``check_rep=`` kwarg) instead of utils/jax_compat — the one spelling
+    that works on both the jax>=0.6 stable API and this image's 0.4.x
+    (CHANGES.md r6: the raw import ImportError'd every ring/pipeline test
+    at seed)."""
+
+    code = "GL006-raw-shard-map"
+    description = ("raw jax.experimental.shard_map / check_rep= bypasses "
+                   "utils/jax_compat.py")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if module.path.replace("\\", "/").endswith(_COMPAT_EXEMPT):
+            return
+        suggestion = ("import shard_map from "
+                      "distributed_pipeline_tpu.utils.jax_compat (version "
+                      "bridge for jax 0.4.x check_rep vs >=0.6 check_vma)")
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.startswith(_RAW_SHARD_MAP) or (
+                        mod == "jax.experimental"
+                        and any(a.name == "shard_map" for a in node.names)):
+                    yield module.finding(
+                        self, node,
+                        f"raw import from {_RAW_SHARD_MAP} — {suggestion}")
+            elif isinstance(node, ast.Attribute) and not isinstance(
+                    module.parent.get(node), ast.Attribute):
+                fn = module.resolve(node)
+                if fn and fn.startswith(_RAW_SHARD_MAP):
+                    yield module.finding(
+                        self, node,
+                        f"direct use of {fn} — {suggestion}")
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "check_rep":
+                        yield module.finding(
+                            self, node,
+                            "check_rep= is the pre-0.6 spelling — call "
+                            "through utils/jax_compat.shard_map with "
+                            "check_vma= instead")

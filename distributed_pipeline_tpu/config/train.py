@@ -54,6 +54,14 @@ class GeneralSettings(S):
                                          "eval decoding (diffuseq only)")
     profile_dir: str = _("", "capture a jax.profiler trace of a few steps "
                              "into this directory (TensorBoard format)")
+    sanitize: bool = _(False, "runtime sanitizer mode: count every XLA "
+                              "compile into a recompile_count gauge "
+                              "(jax_log_compiles) and disallow implicit "
+                              "host<->device transfers inside the train/"
+                              "eval step dispatch — the dynamic half of "
+                              "the graftlint static pass (python -m "
+                              "distributed_pipeline_tpu.analysis); cheap "
+                              "enough for CI runs")
     compilation_cache_dir: str = _(
         "auto", "persistent XLA compilation-cache directory: 'auto' = "
                 "<run_dir>/compile_cache (restarts/resumes of the run "

@@ -128,10 +128,14 @@ def main(ns: argparse.Namespace) -> dict:
     accs, losses, rows = [], [], []
     for i in range(ns.num_batches):
         batch = jax.tree_util.tree_map(jnp.asarray, next(data))
-        r = jax.random.fold_in(rng, i)
-        pred, acc = decode(params, batch, r)
+        # distinct keys per consumer (graftlint GL001): one folded key
+        # feeding both the decode sampler and the eval-loss noise draw
+        # would correlate their randomness
+        r_dec, r_loss = jax.random.split(jax.random.fold_in(rng, i))
+        pred, acc = decode(params, batch, r_dec)
         accs.append(float(acc))
-        losses.append(float(wl.compute_losses(params, batch, r)["loss"]))
+        losses.append(float(wl.compute_losses(params, batch,
+                                              r_loss)["loss"]))
         if ns.out:
             for gold, p_row in zip(
                     jnp.asarray(batch["input_ids"]).tolist(),

@@ -188,6 +188,7 @@ def main(namespace: argparse.Namespace) -> None:
         profile_dir=args.profile_dir,
         warmup_steps=args.warmup_steps,
         keep_checkpoints=args.keep_checkpoints,
+        sanitize=args.sanitize,
     )
     n_m = loop.n_params / 1e6
     logger.info(f"the parameter count is {loop.n_params} ({n_m:.1f}M)")

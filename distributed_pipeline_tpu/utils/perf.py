@@ -9,6 +9,7 @@ first-class gauges, and nothing in the hot path blocks on the device.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Any, Callable, Optional, Tuple
@@ -17,7 +18,7 @@ import jax
 
 __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
            "StepTimer", "mfu", "enable_persistent_compilation_cache",
-           "timed_lower_compile", "AOTStep"]
+           "timed_lower_compile", "AOTStep", "RecompileMonitor"]
 
 # Peak dense bf16 FLOP/s per chip (public spec sheets), matched IN ORDER
 # against jax's device_kind strings — real hardware reports e.g.
@@ -161,6 +162,60 @@ class AOTStep:
             if self._on_compile is not None:
                 self._on_compile(self.name, dt)
         return self._compiled(*args)
+
+
+class RecompileMonitor(logging.Handler):
+    """Counts XLA compilations as they happen — the ``recompile_count``
+    gauge behind sanitizer mode (``--sanitize``) and the bench leg rows.
+
+    The static pass (analysis/, rule GL005) can only point at *patterns*
+    that tend to recompile; this monitor observes the ground truth. It
+    turns on ``jax_log_compiles`` and attaches itself as a logging
+    handler on the ``jax`` logger: every backend compile emits exactly
+    one ``"Compiling <name> ..."`` record (verified against this image's
+    jax 0.4.37 dispatch AND the AOT lower()/compile() path; persistent-
+    cache *hits* don't emit, so a warm restart legitimately counts 0).
+    A steady-state training loop should stop counting after its step
+    functions are built — growth after that is a silent retrace burning
+    the accelerator.
+
+    Use as a context manager or install()/uninstall(). ``count`` is the
+    total since install; ``last`` keeps the most recent compile's name
+    line for diagnostics."""
+
+    _MARKER = "Compiling "
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.NOTSET)
+        self.count = 0
+        self.last: str = ""
+        self._prev_flag: Optional[bool] = None
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # pragma: no cover - malformed record
+            return
+        if msg.startswith(self._MARKER):
+            self.count += 1
+            self.last = msg.split("\n", 1)[0][:200]
+
+    def install(self) -> "RecompileMonitor":
+        self._prev_flag = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        logging.getLogger("jax").addHandler(self)
+        return self
+
+    def uninstall(self) -> None:
+        logging.getLogger("jax").removeHandler(self)
+        if self._prev_flag is not None:
+            jax.config.update("jax_log_compiles", self._prev_flag)
+            self._prev_flag = None
+
+    __enter__ = install
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
 
 
 class StepTimer:

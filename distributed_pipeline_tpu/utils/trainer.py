@@ -30,6 +30,7 @@ of the reference scaffold finds the same control surface.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
@@ -52,8 +53,8 @@ from ..parallel.sharding import (
 )
 from . import checkpoint as ckpt_lib
 from . import logger
-from .perf import AOTStep, StepTimer, device_peak_flops, mfu, \
-    transformer_train_flops_per_token
+from .perf import AOTStep, RecompileMonitor, StepTimer, device_peak_flops, \
+    mfu, transformer_train_flops_per_token
 
 __all__ = ["TrainLoop", "TrainState", "update_ema"]
 
@@ -113,6 +114,7 @@ class TrainLoop:
         warmup_steps: int = 0,
         keep_checkpoints: int = 0,
         eval_batches_consumed: int = 0,
+        sanitize: bool = False,
     ) -> None:
         # Time-to-signal accounting starts at construction: everything up
         # to the end of the first optimizer step (state init, restore,
@@ -153,6 +155,31 @@ class TrainLoop:
         self._profile_window = (3, 8)  # [start, stop) steps after loop entry
         self._profiling = False
 
+        # Runtime sanitizer (the dynamic half of analysis/ graftlint):
+        # count every XLA compile into the recompile_count gauge, and run
+        # the train/eval step dispatch under a jax transfer guard so any
+        # IMPLICIT host<->device transfer (a stray numpy array reaching a
+        # compiled call, a tracer silently fetched) raises instead of
+        # quietly serializing the step. Explicit device_put/device_get —
+        # everything the loop does on purpose — stays legal.
+        self.sanitize = sanitize
+        self._recompiles = RecompileMonitor()
+        if sanitize:
+            self._recompiles.install()
+        try:
+            self._finish_init(mesh, batch_size, seed, resume_checkpoint)
+        except BaseException:
+            # construction can die mid-build (param init / AOT compile is
+            # where an HBM OOM fires) and callers that retry with a smaller
+            # batch (bench.py) never get a handle to stop_sanitizer() —
+            # detach the process-global hooks here so a failed attempt
+            # doesn't leak the 'jax' logging handler or leave
+            # jax_log_compiles stuck on.
+            self._recompiles.uninstall()
+            raise
+
+    def _finish_init(self, mesh, batch_size: int, seed: int,
+                     resume_checkpoint: str) -> None:
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
         # global batch = per-host batch x hosts (reference trainer.py:89)
         self.global_batch = batch_size * jax.process_count()
@@ -292,6 +319,9 @@ class TrainLoop:
         clip = self.gradient_clipping
         opt = self.opt
         rates = self.ema_rates
+        # rate strings -> floats OUTSIDE the traced step (graftlint GL002:
+        # float() under trace is indistinguishable from a device sync)
+        rate_of = {r: float(r) for r in rates}
         pshard = self._pshard
         base_rng = self._base_rng
         lr_at = self._lr_at
@@ -360,7 +390,7 @@ class TrainLoop:
                                             state.params)
             params = optax.apply_updates(state.params, updates)
             params = jax.lax.with_sharding_constraint(params, pshard)
-            ema = {r: update_ema(state.ema[r], params, float(r))
+            ema = {r: update_ema(state.ema[r], params, rate_of[r])
                    for r in rates}
             metrics = dict(metrics)
             metrics["grad_norm"] = gnorm          # device scalar — no sync
@@ -415,6 +445,25 @@ class TrainLoop:
         logger.logkv_sum("compile_time_s", round(seconds, 3))
         logger.info(f"compiled {name} in {seconds:.2f}s")
 
+    @property
+    def recompile_count(self) -> int:
+        """XLA compiles observed since construction (sanitize mode only;
+        0 when the monitor is off). Steady state should freeze this."""
+        return self._recompiles.count
+
+    def stop_sanitizer(self) -> int:
+        """Detach the sanitizer's process-global hooks (the 'jax' logging
+        handler and the jax_log_compiles flag) and return the final
+        recompile count. Idempotent; a no-op when sanitize was off. Call
+        when the loop is done in a process that keeps running (bench legs,
+        tests) — nothing re-arms it."""
+        self._recompiles.uninstall()
+        return self._recompiles.count
+
+    def _sanitize_guard(self):
+        return (jax.transfer_guard("disallow") if self.sanitize
+                else contextlib.nullcontext())
+
     # ------------------------------------------------------------- data prep
 
     def _prepare(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
@@ -438,9 +487,9 @@ class TrainLoop:
     def run_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """One optimizer step (reference run_step, trainer.py:198-201)."""
         first = self.time_to_first_step_s is None
-        with self.mesh:
-            self.state, metrics = self._train_step(self.state,
-                                                   self._prepare(batch))
+        prepared = self._prepare(batch)
+        with self.mesh, self._sanitize_guard():
+            self.state, metrics = self._train_step(self.state, prepared)
         if first:
             # Block once so "time to first step" means a COMPLETED step
             # (async dispatch would otherwise stop the clock at enqueue).
@@ -462,9 +511,9 @@ class TrainLoop:
         # fold_in data must be uint32; offset eval streams away from the
         # train stream (which folds in the raw step).
         rng = jax.random.fold_in(self._base_rng, 0x7FFF0000 + self.step)
-        with self.mesh:
-            metrics = self._eval_step(self.state.params, self._prepare(batch),
-                                      rng)
+        prepared = self._prepare(batch)
+        with self.mesh, self._sanitize_guard():
+            metrics = self._eval_step(self.state.params, prepared, rng)
         logger.logkvs_mean({f"eval_{k}": v for k, v in metrics.items()})
         return metrics
 
@@ -474,6 +523,8 @@ class TrainLoop:
         ``step * global_batch`` unless a subclass overrides it)."""
         logger.logkv("step", self.step)
         logger.logkv("samples", self._samples)
+        if self.sanitize:
+            logger.logkv("recompile_count", self.recompile_count)
 
     def _log_throughput(self) -> None:
         sps, tps = self._timer.lap()

@@ -1,0 +1,485 @@
+"""graftlint tests: per-rule positive/negative fixtures, the CLI JSON
+contract, baseline round-trip + fingerprint invalidation, and the runtime
+sanitizer's RecompileMonitor (ISSUE 4 acceptance: each rule must catch its
+seeded violation)."""
+
+import json
+import textwrap
+
+import pytest
+
+from distributed_pipeline_tpu.analysis import Baseline, all_rules, run_paths
+from distributed_pipeline_tpu.analysis.cli import main as cli_main
+
+
+def lint(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    findings, _ = run_paths([str(p)])
+    return findings
+
+
+def codes(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------ rule catalog
+
+
+def test_catalog_has_all_six_rules():
+    got = {r.code for r in all_rules()}
+    for expected in ("GL001-key-reuse", "GL002-host-sync",
+                     "GL003-donation-after-use", "GL004-impure-jit",
+                     "GL005-recompile-hazard", "GL006-raw-shard-map"):
+        assert expected in got
+
+
+# ------------------------------------------------------------------- GL001
+
+
+def test_key_reuse_two_consumers(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def f(rng):
+            a = jax.random.normal(rng, (2,))
+            b = jax.random.uniform(rng, (2,))
+            return a + b
+    """)
+    assert "GL001-key-reuse" in codes(fs)
+
+
+def test_key_reuse_after_split(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def f(rng):
+            keys = jax.random.split(rng, 3)
+            c = jax.random.normal(rng, (2,))
+            return keys, c
+    """)
+    assert "GL001-key-reuse" in codes(fs)
+
+
+def test_key_reuse_in_loop_without_rebinding(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def f(key):
+            outs = []
+            for i in range(4):
+                outs.append(jax.random.normal(key, (2,)))
+            return outs
+    """)
+    assert "GL001-key-reuse" in codes(fs)
+
+
+def test_key_split_and_fold_in_are_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def f(rng):
+            k1, k2 = jax.random.split(rng)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+        def g(key):
+            outs = []
+            for i in range(4):
+                k = jax.random.fold_in(key, i)
+                outs.append(jax.random.normal(k, (2,)))
+            return outs
+    """)
+    assert "GL001-key-reuse" not in codes(fs)
+
+
+def test_sampler_output_is_not_a_key(tmp_path):
+    # x = normal(key) produces DATA; using x twice is not key reuse
+    fs = lint(tmp_path, """
+        import jax
+        def f(key):
+            x = jax.random.normal(key, (2,))
+            a = x + 1
+            for _ in range(3):
+                a = a + x
+            return a
+    """)
+    assert "GL001-key-reuse" not in codes(fs)
+
+
+def test_key_use_in_one_branch_only_is_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def f(rng, fast):
+            if fast:
+                return jax.random.normal(rng, (2,))
+            return jax.random.uniform(rng, (2,))
+    """)
+    assert "GL001-key-reuse" not in codes(fs)
+
+
+# ------------------------------------------------------------------- GL002
+
+
+def test_host_sync_inside_jit(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        import numpy as np
+        @jax.jit
+        def step(x):
+            v = float(x.sum())
+            y = np.asarray(x)
+            return x * v + y + x.sum().item()
+    """)
+    got = [f for f in fs if f.rule == "GL002-host-sync"]
+    assert len(got) >= 3  # float(), np.asarray, .item()
+
+
+def test_host_sync_outside_trace_is_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        import numpy as np
+        def eager(x):
+            return float(np.asarray(x).sum())
+    """)
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_static_numpy_builders_allowed_under_trace(tmp_path):
+    # np.arange/linspace on static python ints is the respaced-timestep
+    # idiom (models/sampling.py) — must not be flagged
+    fs = lint(tmp_path, """
+        import jax
+        import numpy as np
+        @jax.jit
+        def step(x):
+            ts = np.arange(10)
+            return x + ts.shape[0]
+    """)
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_host_sync_in_scan_body(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def outer(xs):
+            def body(carry, x):
+                return carry + float(x), x
+            return jax.lax.scan(body, 0.0, xs)
+    """)
+    assert "GL002-host-sync" in codes(fs)
+
+
+# ------------------------------------------------------------------- GL003
+
+
+def test_donation_read_after_call(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnums=(0,))
+        def train(state, batch):
+            return state + batch
+        def run(state, batch):
+            new = train(state, batch)
+            stale = state + 1
+            return new, stale
+    """)
+    assert "GL003-donation-after-use" in codes(fs)
+
+
+def test_donation_with_rebinding_is_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def make(f):
+            return jax.jit(f, donate_argnums=(0,))
+        step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+        def run(state, batch):
+            state = step(state, batch)
+            return state + 1
+    """)
+    assert "GL003-donation-after-use" not in codes(fs)
+
+
+def test_donation_through_wrapper_binding(tmp_path):
+    # the trainer idiom: AOTStep(jax.jit(f, donate_argnums=...)) bound to
+    # an attribute, then the donated attribute read after the call
+    fs = lint(tmp_path, """
+        import jax
+        class Wrap:
+            def __init__(self, fn):
+                self.fn = fn
+        step = Wrap(jax.jit(lambda s, b: s + b, donate_argnums=(0,)))
+        def run(holder, batch):
+            out = step(holder.state, batch)
+            leak = holder.state
+            return out, leak
+    """)
+    assert "GL003-donation-after-use" in codes(fs)
+
+
+# ------------------------------------------------------------------- GL004
+
+
+def test_impure_print_and_attr_mutation(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        cfg = {}
+        class Box:
+            pass
+        box = Box()
+        @jax.jit
+        def step(x):
+            print("value", x)
+            box.val = x
+            return x
+    """)
+    got = [f.message for f in fs if f.rule == "GL004-impure-jit"]
+    assert len(got) == 2
+
+
+def test_debug_print_is_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def step(x):
+            jax.debug.print("x {x}", x=x)
+            return x
+    """)
+    assert "GL004-impure-jit" not in codes(fs)
+
+
+def test_logkv_under_trace_flagged(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from distributed_pipeline_tpu.utils import logger
+        def outer(xs):
+            def body(c, x):
+                logger.logkv("x", x)
+                return c, x
+            return jax.lax.scan(body, 0, xs)
+    """)
+    assert "GL004-impure-jit" in codes(fs)
+
+
+# ------------------------------------------------------------------- GL005
+
+
+def test_jit_inside_loop(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        def run(xs):
+            outs = []
+            for x in xs:
+                f = jax.jit(lambda a: a * 2)
+                outs.append(f(x))
+            return outs
+    """)
+    assert "GL005-recompile-hazard" in codes(fs)
+
+
+def test_shape_scalar_into_jitted_call(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        g = jax.jit(lambda a, n: a * n)
+        def run(x):
+            return g(x, len(x)) + g(x, x.shape[0])
+    """)
+    got = [f for f in fs if f.rule == "GL005-recompile-hazard"]
+    assert len(got) == 2
+
+
+def test_module_level_jit_called_in_loop_is_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        f = jax.jit(lambda a: a * 2)
+        def run(xs):
+            return [f(x) for x in xs] + [f(x) for x in xs]
+    """)
+    assert "GL005-recompile-hazard" not in codes(fs)
+
+
+# ------------------------------------------------------------------- GL006
+
+
+def test_raw_shard_map_import_and_check_rep(tmp_path):
+    fs = lint(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+        out = shard_map(lambda x: x, mesh=None, in_specs=None,
+                        out_specs=None, check_rep=False)
+    """)
+    got = [f for f in fs if f.rule == "GL006-raw-shard-map"]
+    assert len(got) == 2  # the import AND the check_rep kwarg
+
+
+def test_compat_shard_map_is_clean(tmp_path):
+    fs = lint(tmp_path, """
+        from distributed_pipeline_tpu.utils.jax_compat import shard_map
+        out = shard_map(lambda x: x, mesh=None, in_specs=None,
+                        out_specs=None, check_vma=False)
+    """)
+    assert "GL006-raw-shard-map" not in codes(fs)
+
+
+def test_jax_compat_itself_is_exempt(tmp_path):
+    fs = lint(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+    """, name="utils/jax_compat.py")
+    assert "GL006-raw-shard-map" not in codes(fs)
+
+
+# ----------------------------------------------------------- parse errors
+
+
+def test_unparseable_file_gates(tmp_path):
+    fs = lint(tmp_path, "def broken(:\n")
+    assert "GL000-parse-error" in codes(fs)
+
+
+# ------------------------------------------------------------ CLI contract
+
+
+BAD_SRC = """
+import jax
+def f(rng):
+    a = jax.random.normal(rng, (2,))
+    b = jax.random.uniform(rng, (2,))
+    return a + b
+"""
+
+
+def test_cli_json_contract(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(BAD_SRC)
+    rc = cli_main(["--format", "json", "--baseline", "none", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["version"] == 1 and out["tool"] == "graftlint"
+    assert out["checked_files"] == 1 and out["baselined"] == 0
+    assert len(out["rules"]) >= 6
+    (finding,) = [f for f in out["findings"]
+                  if f["rule"] == "GL001-key-reuse"]
+    for key in ("rule", "path", "line", "col", "message", "snippet",
+                "fingerprint"):
+        assert key in finding
+    assert finding["line"] == 5  # the second consumer is the finding
+
+
+def test_cli_clean_exits_zero(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("import jax\nx = 1\n")
+    rc = cli_main(["--format", "json", "--baseline", "none", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["findings"] == []
+
+
+def test_cli_rule_filter_and_list(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(BAD_SRC)
+    rc = cli_main(["--format", "json", "--baseline", "none",
+                   "--rules", "GL006", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["rules"] == ["GL006-raw-shard-map"]
+    assert cli_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    assert "GL001-key-reuse" in listed and "GL006-raw-shard-map" in listed
+
+
+def test_cli_usage_errors(tmp_path, capsys):
+    assert cli_main([]) == 2
+    (tmp_path / "bad.py").write_text(BAD_SRC)
+    assert cli_main(["--rules", "NOPE", str(tmp_path)]) == 2
+
+
+# ------------------------------------------------------- baseline contract
+
+
+def test_baseline_round_trip(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(BAD_SRC)
+    bl = tmp_path / "graftlint_baseline.json"
+
+    # 1. write the baseline: everything current is audited-allowed
+    rc = cli_main(["--baseline", str(bl), "--write-baseline", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 0 and bl.exists()
+    data = json.loads(bl.read_text())
+    assert data["version"] == 1 and len(data["entries"]) == 1
+
+    # 2. gated run is now clean, findings counted as baselined
+    rc = cli_main(["--format", "json", "--baseline", str(bl),
+                   str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["findings"] == [] and out["baselined"] == 1
+
+    # 3. a NEW hazard still fails the gate
+    (tmp_path / "new.py").write_text(BAD_SRC)
+    rc = cli_main(["--format", "json", "--baseline", str(bl),
+                   str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and len(out["findings"]) == 1
+
+    # 4. editing the baselined LINE invalidates its fingerprint (the
+    # audit no longer vouches for the changed code)
+    (tmp_path / "new.py").unlink()
+    (tmp_path / "bad.py").write_text(BAD_SRC.replace(
+        "jax.random.uniform(rng, (2,))", "jax.random.uniform(rng, (3,))"))
+    rc = cli_main(["--format", "json", "--baseline", str(bl),
+                   str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["baselined"] == 0
+
+
+def test_baseline_auto_discovery_from_cwd(tmp_path, capsys, monkeypatch):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(BAD_SRC)
+    monkeypatch.chdir(tmp_path)
+    assert cli_main(["--write-baseline", "pkg"]) == 0
+    capsys.readouterr()
+    # the acceptance-criteria invocation shape: no --baseline flag at all
+    rc = cli_main(["--format", "json", "pkg"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["baselined"] == 1
+    assert out["baseline"].endswith("graftlint_baseline.json")
+
+
+def test_baseline_fingerprints_survive_line_shifts(tmp_path):
+    (tmp_path / "bad.py").write_text(BAD_SRC)
+    before, _ = run_paths([str(tmp_path)])
+    (tmp_path / "bad.py").write_text("# a comment pushing lines down\n"
+                                     * 7 + BAD_SRC)
+    after, _ = run_paths([str(tmp_path)])
+    assert [f.fingerprint for f in before] == [f.fingerprint for f in after]
+    assert before[0].line != after[0].line
+
+
+def test_baseline_api_round_trip(tmp_path):
+    (tmp_path / "bad.py").write_text(BAD_SRC)
+    findings, _ = run_paths([str(tmp_path)])
+    bl = Baseline.from_findings(findings)
+    path = tmp_path / "bl.json"
+    bl.save(str(path))
+    loaded = Baseline.load(str(path))
+    new, old = loaded.split(findings)
+    assert new == [] and old == findings
+    with pytest.raises(ValueError):
+        path.write_text('{"oops": true}')
+        Baseline.load(str(path))
+
+
+# -------------------------------------------------------- runtime sanitizer
+
+
+def test_recompile_monitor_counts_fresh_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pipeline_tpu.utils.perf import RecompileMonitor
+
+    with RecompileMonitor() as mon:
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        f(jnp.ones((3, 5)))
+        first = mon.count
+        assert first >= 1
+        assert mon.last.startswith("Compiling")
+        f(jnp.ones((3, 5)))          # cache hit: no growth
+        assert mon.count == first
+        f(jnp.ones((4, 5)))          # new shape: retrace + recompile
+        assert mon.count > first
+    after = mon.count
+    jax.jit(lambda x: x * 3.0 - 7.0)(jnp.ones((2, 2)))
+    assert mon.count == after        # uninstalled: counting stopped

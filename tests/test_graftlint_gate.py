@@ -1,0 +1,62 @@
+"""Tier-1 CI gate: graftlint over the shipped code must be clean against
+the committed baseline (graftlint_baseline.json at the repo root). A new
+hazard — PRNG reuse, host sync under jit, donation misuse, impurity,
+recompile pattern, compat bypass — fails this test until it is either
+fixed or explicitly audited into the baseline."""
+
+import os
+
+import pytest
+
+from distributed_pipeline_tpu.analysis import Baseline, run_paths
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "graftlint_baseline.json")
+GATED_PATHS = [
+    os.path.join(ROOT, "distributed_pipeline_tpu"),
+    os.path.join(ROOT, "artifacts"),
+    os.path.join(ROOT, "bench.py"),
+    os.path.join(ROOT, "__graft_entry__.py"),
+]
+
+
+@pytest.fixture(scope="module")
+def gate_run():
+    """One lint of the gated paths shared by the gate tests (the full
+    AST pass over 45+ files costs ~2s — no reason to pay it twice)."""
+    return run_paths(GATED_PATHS)
+
+
+def test_committed_baseline_exists_and_is_valid():
+    bl = Baseline.load(BASELINE)
+    for e in bl.entries:  # every entry must carry its audit trail fields
+        assert {"rule", "path", "snippet", "fingerprint"} <= set(e)
+
+
+def test_package_lints_clean_against_baseline(gate_run):
+    findings, n_files = gate_run
+    assert n_files > 40  # the walk really covered the package
+    new, _ = Baseline.load(BASELINE).split(findings)
+    report = "\n".join(
+        f"  {os.path.relpath(f.path, ROOT)}:{f.line}: {f.rule} {f.message}"
+        for f in new)
+    assert not new, (
+        f"graftlint found {len(new)} new hazard(s) — fix them or audit "
+        f"them into graftlint_baseline.json (python -m "
+        f"distributed_pipeline_tpu.analysis --write-baseline <paths>):\n"
+        f"{report}")
+
+
+def test_baseline_has_no_stale_entries(gate_run):
+    """Entries whose finding no longer exists are audit debt: the flagged
+    line changed or was fixed, so the entry vouches for nothing. Keeps
+    the committed file honest (regenerate it after fixing a finding)."""
+    findings, _ = gate_run
+    live = {f.fingerprint for f in findings}
+    stale = [e for e in Baseline.load(BASELINE).entries
+             if e["fingerprint"] not in live]
+    assert not stale, (
+        "baseline entries no longer match any finding (regenerate with "
+        f"--write-baseline): {[e['snippet'] for e in stale]}")

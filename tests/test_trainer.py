@@ -508,3 +508,56 @@ def test_zero_intervals_disable_periodic_actions(tmp_path):
     assert loop.step == 3
     saved = sorted(d for d in os.listdir(tmp_path) if d.startswith("model_"))
     assert saved == ["model_000003"]  # exit save only, no periodic saves
+
+
+# ---------------------------------------------------------- sanitizer mode
+
+def test_sanitize_mode_counts_compiles_and_guards_transfers(tmp_path):
+    """--sanitize (the runtime half of graftlint): recompile_count freezes
+    once the step functions are built — growth across steady-state steps
+    is exactly the silent-retrace regression the gauge exists to catch —
+    and the step dispatch runs under a transfer guard that rejects
+    implicit host->device transfers while the loop's own explicit
+    device_put path keeps working."""
+    loop = make_loop(tmp_path, sanitize=True)
+    try:
+        loop.run_step(next(loop.data))
+        after_first = loop.recompile_count
+        assert after_first >= 1  # init + train_step compiles were observed
+        for _ in range(3):
+            loop.run_step(next(loop.data))
+        assert loop.step == 4
+        assert loop.recompile_count == after_first  # steady state: frozen
+        with logger.scoped_configure(dir=str(tmp_path / "l"),
+                                     format_strs=["json"]):
+            loop.log_step()
+            assert logger.dumpkvs()["recompile_count"] == after_first
+
+        # the guard really is armed: an implicit np->device transfer
+        # inside the guarded region must raise, not silently transfer
+        f = jax.jit(lambda x: x * 2)
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            with loop._sanitize_guard():
+                f(np.ones(3)).block_until_ready()
+
+        # the monitor is still live outside the guard: a deliberate fresh
+        # compile (distinctive constants so no cache can satisfy it) must
+        # be counted
+        g = jax.jit(lambda x: x * 3.14159 + 2.71828)
+        g(jnp.ones(3)).block_until_ready()
+        assert loop.recompile_count > after_first
+        live = loop.recompile_count
+    finally:
+        final = loop.stop_sanitizer()
+    assert final == live  # stop returns the count at detach time
+    # and counting really stops once detached
+    h = jax.jit(lambda x: x * 1.41421 - 0.57721)
+    h(jnp.ones(3)).block_until_ready()
+    assert loop.recompile_count == final
+    loop.stop_sanitizer()  # idempotent
+
+
+def test_sanitize_off_by_default(tmp_path):
+    loop = make_loop(tmp_path)
+    loop.run_step(next(loop.data))
+    assert not loop.sanitize and loop.recompile_count == 0
