@@ -13,11 +13,40 @@ Output contract (the driver parses stdout, humans watch stderr):
   as it finishes — so a timeout can no longer destroy the whole run's signal
   (the r5 failure mode: rc=124 after 12 legs of work, zero numbers captured).
 
-Budget: ``BENCH_BUDGET_S`` (seconds, default 600 — sized to sit inside the
-driver's timeout). The headline leg always runs; before each later leg the
-elapsed wall clock is checked and remaining legs are skipped with explicit
-markers once the budget is spent. Legs run headline-first so a truncated run
-always contains the north star.
+Budget: ``BENCH_BUDGET_S`` (seconds, default 480 — sized to sit inside the
+driver's timeout with headroom). The headline leg always runs; before each
+later leg the elapsed wall clock is checked and remaining legs are skipped
+with explicit markers once the budget is spent. Legs run headline-first so a
+truncated run always contains the north star.
+
+Three layers make ``parsed: null`` impossible (the BENCH_r05 regression —
+rc=124 with ZERO rows because the run wedged inside a leg):
+
+1. per-leg HARD CAP: every leg runs under a SIGALRM deadline
+   (``BENCH_LEG_BUDGET_S``, default 240, further clamped to the remaining
+   budget; the headline leg gets max(80% of the whole budget, 120s) — it is
+   exempt from the budget SKIP but not from a wedge cap). A leg that
+   overruns becomes an ``error`` row, not a hung process.
+2. SIGTERM net: the driver's soft kill is caught, remaining legs are
+   marked skipped, and the final JSON still prints.
+3. watchdog thread: if the main thread is wedged in native code (where a
+   Python signal handler cannot run — a stuck compile or a wedged remote
+   chip), a daemon watchdog prints the final JSON from the completed rows
+   at budget+60s and exits 3.
+
+Steady-state A/B (ISSUE 5): the headline (prefetch OFF) is immediately
+followed by a PAIRED A/B leg at the same config — an OFF loop and an ON
+loop (device prefetch + async metrics dispatch,
+``BENCH_PREFETCH_DEPTH``/``BENCH_DISPATCH_LAG``, defaults 2/1) both kept
+alive while short timed windows interleave between them, order
+alternating each round. Sequential legs measure the box as much as the
+code (a shared host's steady-state rate drifts enough to flip the delta
+sign run to run); interleaving hits both arms with the same drift, and
+the ``prefetch-ab-delta`` row reports the position-balanced totals ratio
+(ABBA ordering cancels the measured second-window position cost). Every
+train row carries ``steps_per_s`` plus the four stall-breakdown gauges
+(``data_wait_s``/``h2d_wait_s``/``dispatch_s``/``device_step_s``, mean
+seconds per step over the timed window).
 
 Compile cost is first-class: a persistent XLA compilation cache
 (``BENCH_CACHE_DIR``, default ``model_checkpoints/bench/compile_cache``,
@@ -39,13 +68,59 @@ from __future__ import annotations
 import functools
 import json
 import os
+import signal
 import sys
+import threading
 import time
+
+
+class LegTimeout(Exception):
+    """A leg overran its SIGALRM hard cap."""
+
+
+class BenchInterrupted(Exception):
+    """The driver sent SIGTERM (its soft kill before SIGKILL)."""
+
+
+def _run_capped(thunk, cap_s: float):
+    """Run one leg under a SIGALRM deadline. Raises LegTimeout on overrun
+    so the leg becomes an error row instead of a hung process. (A native
+    call that never returns to the interpreter can still outlive this —
+    the watchdog thread is the terminal backstop for that case.)"""
+
+    def _on_alarm(signum, frame):
+        raise LegTimeout(f"leg exceeded its {cap_s:.0f}s hard cap")
+
+    unset = object()
+    row = unset
+    prev = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, max(cap_s, 1.0))
+    try:
+        try:
+            try:
+                row = thunk()
+            finally:
+                # cleared the instant the call ends — success OR error —
+                # so a late alarm can neither land in the caller's
+                # cleanup nor replace a real exception mid-unwind
+                signal.setitimer(signal.ITIMER_REAL, 0.0)
+        except LegTimeout:
+            if row is not unset:
+                # The alarm fired in the gap between the leg completing
+                # and the itimer being cleared: the row is fully computed
+                # — keep it instead of discarding a finished leg.
+                return row
+            raise
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+    return row
 
 
 def main() -> None:
     t_bench0 = time.perf_counter()
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "600"))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "480"))
+    leg_budget_s = float(os.environ.get("BENCH_LEG_BUDGET_S", "240"))
     artifact_path = os.environ.get("BENCH_ARTIFACT", "bench_legs.jsonl")
 
     import jax
@@ -83,7 +158,9 @@ def main() -> None:
                 vocab: int = 8192, attention_impl: str = "auto",
                 moe_experts: int = 0, moe_top_k: int = 2,
                 moe_capacity_factor: float = 1.25,
-                scan_layers: bool = False):
+                scan_layers: bool = False,
+                prefetch_depth: int = 0, dispatch_lag: int = 0,
+                steady_steps: int = 0):
         """tokens/sec for one config; the first step is timed separately
         (compile + dispatch) from the steady-state window. ``batch`` is PER
         HOST (reference trainer.py:89 semantics: global = batch x hosts); a
@@ -100,7 +177,15 @@ def main() -> None:
                                    moe_experts=moe_experts,
                                    moe_top_k=moe_top_k,
                                    moe_capacity_factor=moe_capacity_factor,
-                                   scan_layers=scan_layers)
+                                   scan_layers=scan_layers,
+                                   prefetch_depth=prefetch_depth,
+                                   dispatch_lag=dispatch_lag,
+                                   steady_steps=steady_steps)
+                except (LegTimeout, BenchInterrupted):
+                    # Not an OOM: the per-leg SIGALRM cap / driver SIGTERM
+                    # must reach the leg runner, not restart at a smaller
+                    # batch with the itimer already consumed.
+                    raise
                 except Exception as e:
                     if i == len(batch) - 1:
                         raise
@@ -134,7 +219,8 @@ def main() -> None:
                          ema_rate="0.9999", learning_steps=0,
                          log_interval=10 ** 9, save_interval=10 ** 9,
                          mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0,
-                         sanitize=True)
+                         sanitize=True, prefetch_depth=prefetch_depth,
+                         dispatch_lag=dispatch_lag)
         # First step paid separately: with the AOT step (utils/trainer.py)
         # its wall time is compile + dispatch + one step, and
         # loop.compile_time_s isolates the lower()/compile() share — the
@@ -146,28 +232,36 @@ def main() -> None:
         # (A TrainLoop that dies during CONSTRUCTION detaches its own
         # monitor — see TrainLoop.__init__ — so the retry loop above is
         # covered too.)
+        n_steady = steady_steps or steps
         try:
             t0 = time.perf_counter()
-            m = loop.run_step(next(loop.data))
+            m = loop.run_step(loop.next_batch())
             float(jax.device_get(m["loss"]))
             first_step_s = time.perf_counter() - t0
             # Warmup: fill the loader prefetch queues + let dispatch
             # pipeline to depth — a cold 1-step warmup undermeasures steady
             # state by ~10% (62.3% -> 68.8% MFU on the v5e headline).
-            for _ in range(7 if on_tpu else 0):
-                m = loop.run_step(next(loop.data))
+            for _ in range(7 if on_tpu else 2):
+                m = loop.run_step(loop.next_batch())
             # device_get, not block_until_ready: the latter can UNDER-block
             # through a remote-accelerator tunnel (returns before the queue
             # drains), inflating throughput by whatever was still in flight.
             float(jax.device_get(m["loss"]))
+            loop.stalls.lap()  # reset the window: gauges cover ONLY the
+            # steady timed steps below, not compile/warmup
             t0 = time.perf_counter()
-            for _ in range(steps):
-                m = loop.run_step(next(loop.data))
+            for _ in range(n_steady):
+                m = loop.run_step(loop.next_batch())
             float(jax.device_get(m["loss"]))
             dt = time.perf_counter() - t0
+            # flush BEFORE lap: the drain emits the last dispatch_lag
+            # steps' device_step_s samples into the stall window (same
+            # order as measure_prefetch_ab)
+            loop.flush_metrics()
+            stall = loop.stalls.lap()
         finally:
             recompiles = loop.stop_sanitizer()
-        tps = steps * batch * seq_len * jax.process_count() / dt
+        tps = n_steady * batch * seq_len * jax.process_count() / dt
         # MFU against ACTIVE params: a top-k routed MoE block only runs
         # top_k of its moe_experts expert MLPs per token, so counting every
         # expert's weights would overstate the model flops. Inactive mass
@@ -193,13 +287,15 @@ def main() -> None:
                               * (moe_experts - moe_top_k) / moe_experts)
         fpt = transformer_train_flops_per_token(
             n_active, wl.num_layers, wl.hidden_size, seq_len)
-        return {
+        row = {
             "name": name,
             "tokens_per_sec_per_chip": round(tps / jax.device_count(), 1),
+            "steps_per_s": round(n_steady / dt, 4),
             "mfu": round(mfu(tps, fpt), 4),
             "n_params": loop.n_params,
             "batch": batch, "microbatch": microbatch or batch,
             "seq_len": seq_len, "remat": remat,
+            "prefetch_depth": prefetch_depth, "dispatch_lag": dispatch_lag,
             "compile_s": round(loop.compile_time_s or 0.0, 3),
             "first_step_s": round(first_step_s, 3),
             "time_to_first_step_s": round(loop.time_to_first_step_s or 0.0,
@@ -209,6 +305,13 @@ def main() -> None:
             # even when tokens/sec still looks plausible
             "recompile_count": recompiles,
         }
+        # Stall breakdown over the timed window (mean s/step): data_wait_s
+        # (blocked on the host iterator), h2d_wait_s (blocked on transfer/
+        # placement), dispatch_s (enqueue), device_step_s (trailing
+        # dispatch->ready span, observed via the lagged fetch; 0.0 in
+        # eager-dispatch legs, which never block on a step to measure it).
+        row.update({k: round(v, 6) for k, v in stall.items()})
+        return row
 
     def measure_decode(name: str, *, gen_tokens: int, batch: int,
                        seq_len: int, vocab: int = 8192):
@@ -256,6 +359,156 @@ def main() -> None:
             "compile_s": round(compile_s, 3),
         }
 
+    def measure_prefetch_ab(name: str, *, family: str, size: str,
+                            seq_len: int, batch: int, microbatch: int = 0,
+                            window_steps: int = 4, rounds: int = 6,
+                            prefetch_depth: int = 2, dispatch_lag: int = 1):
+        """Paired interleaved prefetch A/B at the headline settings.
+
+        Sequential OFF-then-ON legs measure the box as much as the code: on
+        a shared/throttled host the steady-state rate drifts tens of
+        percent over tens of seconds, so one pair of windows flips the
+        delta's sign run to run (observed on this box: same config ranged
+        24->38 steps/s across back-to-back reps). Here BOTH loops stay
+        alive and short timed windows interleave between them, order
+        alternating each round (ABBA), so slow drift hits the two arms
+        equally. The delta comes from the POSITION-BALANCED TOTALS: on
+        this box the second of two back-to-back windows runs ~25% slower
+        regardless of arm (scheduler/cache position effect, measured), so
+        per-round ratios are bimodal — but with ``rounds`` even, ABBA
+        gives each arm first position exactly half the time and the
+        position cost cancels in the summed times. Returns the
+        prefetch-ON leg row (same schema as ``measure``) with the paired
+        baseline attached as ``ab_*`` fields — the ``prefetch-ab-delta``
+        row is derived from these, not from cross-leg numbers taken at
+        different times."""
+        if rounds % 2:
+            rounds += 1  # even rounds: the ABBA position balance above
+        dims = dict(vocab_size=8192) if on_tpu else dict(
+            hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
+        dataset = "synthetic-lm" if family == "gpt2" else "synthetic-seq2seq"
+
+        def build(depth: int, lag: int) -> TrainLoop:
+            wl = create_model_from_config(
+                model_family=family, model_size=size, seq_len=seq_len,
+                dtype=dtype, **dims)
+            data = load_data_from_args(
+                "train", batch_size=batch, dataset=dataset, seq_len=seq_len,
+                vocab_size=dims["vocab_size"], seed=0, num_loader_proc=2)
+            # Both arms sanitize: the transfer-guard context is entered per
+            # step, so only a symmetric pair is a fair timing comparison.
+            return TrainLoop(model=wl, data=data, batch_size=batch,
+                             microbatch=microbatch or batch, lr=1e-4,
+                             ema_rate="0.9999", learning_steps=0,
+                             log_interval=10 ** 9, save_interval=10 ** 9,
+                             mesh=make_mesh(dp=-1), checkpoint_dir="",
+                             seed=0, sanitize=True, prefetch_depth=depth,
+                             dispatch_lag=lag)
+
+        warm = 7 if on_tpu else 2
+
+        def warmup(loop: TrainLoop) -> float:
+            t0 = time.perf_counter()
+            m = loop.run_step(loop.next_batch())
+            float(jax.device_get(m["loss"]))
+            first_step_s = time.perf_counter() - t0
+            for _ in range(warm):
+                m = loop.run_step(loop.next_batch())
+            float(jax.device_get(m["loss"]))
+            loop.flush_metrics()
+            loop.stalls.lap()  # gauges cover only the timed windows
+            return first_step_s
+
+        def window(loop: TrainLoop) -> float:
+            t0 = time.perf_counter()
+            for _ in range(window_steps):
+                m = loop.run_step(loop.next_batch())
+            float(jax.device_get(m["loss"]))
+            return time.perf_counter() - t0
+
+        # Two live TrainLoops double the device residency of measure()'s
+        # single loop, and the scalar batch arg has no tuple ladder — so
+        # an HBM OOM falls back by halving (keeping the PAIRED protocol)
+        # instead of erroring out the one leg whose delta row the bench
+        # exists to produce. The row's "batch" reports the size that ran.
+        requested_batch = batch
+        while True:
+            try:
+                # OFF arm is built and warmed FIRST, so the ON arm's
+                # RecompileMonitor (installed at its construction) never
+                # sees the OFF arm's compiles — the reported
+                # recompile_count is the ON loop's own compiles plus any
+                # steady-window retrace from either arm, which is exactly
+                # the regression the gauge exists to catch. (Both
+                # monitors hook the process-global 'jax' logger; they are
+                # uninstalled in reverse install order below so their
+                # saved jax_log_compiles flags nest correctly.)
+                loop_off = build(0, 0)
+                try:
+                    warmup(loop_off)
+                    loop_on = build(prefetch_depth, dispatch_lag)
+                    try:
+                        first_step_s = warmup(loop_on)
+                        off_dts: list = []
+                        on_dts: list = []
+                        for r in range(rounds):
+                            pair = ((loop_off, off_dts), (loop_on, on_dts))
+                            for loop, dts in (pair[::-1] if r % 2 else pair):
+                                dts.append(window(loop))
+                        loop_on.flush_metrics()  # drain the lagged ring
+                        stall = loop_on.stalls.lap()
+                    finally:
+                        recompiles = loop_on.stop_sanitizer()
+                finally:
+                    loop_off.stop_sanitizer()
+            except (LegTimeout, BenchInterrupted):
+                raise
+            except Exception as e:
+                msg = str(e)
+                if (batch <= 1 or ("RESOURCE_EXHAUSTED" not in msg
+                                   and "out of memory" not in msg.lower())):
+                    raise
+                print(f"# {name}: batch {batch} OOM with two live loops; "
+                      f"retrying A/B at {batch // 2}", file=sys.stderr,
+                      flush=True)
+                batch //= 2
+                microbatch = min(microbatch, batch) if microbatch else 0
+                continue
+            break
+        n_steps = rounds * window_steps
+        off_sps = n_steps / sum(off_dts)
+        on_sps = n_steps / sum(on_dts)
+        # identical step counts, so the totals ratio IS the rate ratio
+        delta_pct = 100.0 * (sum(off_dts) / sum(on_dts) - 1.0)
+        tps = (n_steps * batch * seq_len * jax.process_count()
+               / sum(on_dts))
+        fpt = transformer_train_flops_per_token(
+            loop_on.n_params, loop_on.workload.num_layers,
+            loop_on.workload.hidden_size, seq_len)
+        row = {
+            "name": name,
+            "tokens_per_sec_per_chip": round(tps / jax.device_count(), 1),
+            "steps_per_s": round(on_sps, 4),
+            "mfu": round(mfu(tps, fpt), 4),
+            "n_params": loop_on.n_params,
+            "batch": batch, "microbatch": microbatch or batch,
+            "seq_len": seq_len, "remat": False,
+            "prefetch_depth": prefetch_depth, "dispatch_lag": dispatch_lag,
+            "compile_s": round(loop_on.compile_time_s or 0.0, 3),
+            "first_step_s": round(first_step_s, 3),
+            "time_to_first_step_s": round(loop_on.time_to_first_step_s
+                                          or 0.0, 3),
+            "recompile_count": recompiles,
+            "ab_method": "paired-interleaved",
+            "ab_rounds": rounds, "ab_window_steps": window_steps,
+            "ab_off_steps_per_s": round(off_sps, 4),
+            "ab_delta_pct": round(delta_pct, 2),
+        }
+        if batch != requested_batch:
+            row["ab_batch_fallback"] = True
+        row.update({k: round(v, 6) for k, v in stall.items()})
+        return row
+
     # Per-chip batch sizes are the measured MFU sweet spots on v5e (base:
     # 64/128/256/512 sweep in r2; large/gpt2 sized to fit one chip's HBM
     # with the single-EMA bench loop); tiny on CPU so smoke runs finish.
@@ -271,7 +524,24 @@ def main() -> None:
         # working set schedules better).
         ("diffuseq-base-seq128", functools.partial(
             measure, "diffuseq-base-seq128", family="diffuseq", size="base",
-            seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1)),
+            seq_len=128, batch=bsz(256), microbatch=bsz(256) // 4 or 1,
+            steady_steps=30 if on_tpu else 12)),
+        # Steady-state A/B (ISSUE 5): the EXACT headline settings with
+        # device-side double-buffered prefetch + async lagged-metrics
+        # dispatch ON, measured as PAIRED INTERLEAVED windows against a
+        # live prefetch-OFF twin (see measure_prefetch_ab: sequential legs
+        # confound the delta with host drift). On TPU the batch transfer
+        # overlaps the running step (the real win); on CPU (synchronous
+        # backend) the contract is "no slower". The prefetch-ab-delta row
+        # below reports the paired delta.
+        ("diffuseq-base-seq128-prefetch", functools.partial(
+            measure_prefetch_ab, "diffuseq-base-seq128-prefetch",
+            family="diffuseq", size="base", seq_len=128, batch=bsz(256),
+            microbatch=bsz(256) // 4 or 1,
+            window_steps=10 if on_tpu else 4,
+            rounds=6 if on_tpu else 32,
+            prefetch_depth=int(os.environ.get("BENCH_PREFETCH_DEPTH", "2")),
+            dispatch_lag=int(os.environ.get("BENCH_DISPATCH_LAG", "1")))),
         # no-accumulation variant (pure config-2 semantics)
         ("diffuseq-base-seq128-noaccum", functools.partial(
             measure, "diffuseq-base-seq128-noaccum", family="diffuseq",
@@ -377,51 +647,146 @@ def main() -> None:
               f"{time.perf_counter() - t_bench0:.0f}s]", file=sys.stderr,
               flush=True)
 
-    for i, (name, thunk) in enumerate(legs):
-        elapsed = time.perf_counter() - t_bench0
-        # The HEADLINE leg (first in the list) is exempt: a bench run that
-        # reports nothing is strictly worse than one that overruns a little,
-        # and the final JSON's `value` is this leg.
-        if i > 0 and elapsed > budget_s:
-            emit({"name": name, "skipped": "budget"})
-            continue
-        try:
-            emit(thunk())
-        except Exception as e:
-            # One leg must not sink the others (or the final JSON line).
-            emit({"name": name,
-                  "error": f"{type(e).__name__}: {e}"[:500]})
+    # ------------------------------------------------------- hang hardening
+    # The final JSON must print NO MATTER WHAT happens inside a leg (the
+    # BENCH_r05 regression: the whole run wedged inside leg 1, rc=124,
+    # parsed: null). Three nets, outermost last:
+    #   per-leg SIGALRM cap -> SIGTERM catch -> native-hang watchdog.
+    printed = threading.Lock()
 
-    # The headline contract holds only for a FULL leg list (legs[0] is the
-    # DiffuSeq north star). Under BENCH_ONLY (iteration mode) the first
-    # surviving train config — if any — is reported under its own name,
-    # never as the north star. In a full run the headline value must come
-    # from the headline LEG specifically: if that leg errored, report null
-    # (its error row stays in configs) rather than silently promoting the
-    # next leg's numbers under the north-star label.
-    if only:
-        head = next((c for c in configs if "mfu" in c), None)
-    else:
-        head = configs[0] if configs and "mfu" in configs[0] else None
-    if only and head is not None:
-        metric = (f"tokens/sec/chip ({head['name']} [BENCH_ONLY={only}], "
-                  f"{jax.devices()[0].device_kind})")
-    else:
-        metric = ("tokens/sec/chip (DiffuSeq-base seq128 train, "
-                  f"{jax.devices()[0].device_kind})")
-    print(json.dumps({
-        "metric": metric,
-        "value": head["tokens_per_sec_per_chip"] if head else None,
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(head["mfu"] / 0.40, 4) if head else None,
-        "mfu": head["mfu"] if head else None,
-        "n_params": head["n_params"] if head else None,
-        "n_devices": jax.device_count(),
-        "budget_s": budget_s,
-        "elapsed_s": round(time.perf_counter() - t_bench0, 1),
-        "compilation_cache": cache_dir,
-        "configs": configs,
-    }))
+    def final_payload() -> str:
+        if only:
+            head = next((c for c in configs if "mfu" in c), None)
+        else:
+            head = configs[0] if configs and "mfu" in configs[0] else None
+        if only and head is not None:
+            metric = (f"tokens/sec/chip ({head['name']} [BENCH_ONLY={only}], "
+                      f"{jax.devices()[0].device_kind})")
+        else:
+            metric = ("tokens/sec/chip (DiffuSeq-base seq128 train, "
+                      f"{jax.devices()[0].device_kind})")
+        return json.dumps({
+            "metric": metric,
+            "value": head["tokens_per_sec_per_chip"] if head else None,
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(head["mfu"] / 0.40, 4) if head else None,
+            "mfu": head["mfu"] if head else None,
+            "n_params": head["n_params"] if head else None,
+            "n_devices": jax.device_count(),
+            "budget_s": budget_s,
+            "elapsed_s": round(time.perf_counter() - t_bench0, 1),
+            "compilation_cache": cache_dir,
+            "configs": configs,
+        })
+
+    def print_final_once() -> None:
+        if printed.acquire(blocking=False):
+            print(final_payload(), flush=True)
+
+    # The headline leg is EXEMPT from the budget skip (a bench run that
+    # reports nothing is strictly worse than one that overruns a little),
+    # so its hard cap gets a 120s floor — a 1s test budget must not kill
+    # the one leg whose numbers are the contract. It is still capped: the
+    # r5 wedge (a leg that never returns) cannot eat the driver's timeout.
+    headline_cap_s = max(budget_s * 0.8, 120.0)
+
+    # Anchored HERE — after jax import / distributed init / cache setup —
+    # not at t_bench0: the per-leg SIGALRM caps are leg-start-relative, so
+    # a slow startup (minutes on a TPU pod) must not let the watchdog
+    # shoot a headline leg that is still inside its own hard cap.
+    t_legs0 = time.perf_counter()
+
+    def _watchdog() -> None:
+        # Terminal backstop: a native call that never returns to the
+        # interpreter (stuck XLA compile, wedged remote chip) defeats both
+        # signal handlers — after the longest legitimate wall clock plus
+        # 60s grace, print the completed rows and exit hard. The thread is
+        # a daemon: a normal finish just abandons it.
+        deadline = t_legs0 + max(budget_s, headline_cap_s) + 60.0
+        while time.perf_counter() < deadline:
+            time.sleep(1.0)
+        try:
+            print("# bench watchdog: wall clock exceeded budget inside a "
+                  "leg; emitting final JSON with completed rows",
+                  file=sys.stderr, flush=True)
+            print_final_once()
+        finally:
+            # exit even if the prints raise (closed pipe): a wedged
+            # process that lingers past the backstop defeats its purpose
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    def _on_term(signum, frame):
+        raise BenchInterrupted()
+
+    prev_term = signal.signal(signal.SIGTERM, _on_term)
+
+    try:
+        try:
+            for i, (name, thunk) in enumerate(legs):
+                elapsed = time.perf_counter() - t_bench0
+                if i > 0 and elapsed > budget_s:
+                    emit({"name": name, "skipped": "budget"})
+                    continue
+                cap = (headline_cap_s if i == 0
+                       else min(leg_budget_s, budget_s - elapsed))
+                try:
+                    emit(_run_capped(thunk, cap))
+                except BenchInterrupted:
+                    raise
+                except Exception as e:
+                    # One leg must not sink the others (or the final JSON
+                    # line).
+                    emit({"name": name,
+                          "error": f"{type(e).__name__}: {e}"[:500]})
+        except BenchInterrupted:
+            done = {c.get("name") for c in configs}
+            for name, _ in legs:
+                if name not in done:
+                    emit({"name": name, "skipped": "sigterm"})
+            print("# bench: SIGTERM received; emitting final JSON with "
+                  "completed rows", file=sys.stderr, flush=True)
+
+        # Steady-state A/B delta row: prefetch-off vs prefetch-on at
+        # identical settings — the number ISSUE 5 exists to produce. Both
+        # sides come from the SAME paired-interleaved leg
+        # (measure_prefetch_ab), never from two legs timed minutes apart
+        # on a drifting host.
+        on = next((c for c in configs
+                   if c.get("name") == "diffuseq-base-seq128-prefetch"
+                   and "ab_delta_pct" in c), None)
+        if on:
+            emit({"name": "prefetch-ab-delta",
+                  "off_steps_per_s": on["ab_off_steps_per_s"],
+                  "on_steps_per_s": on["steps_per_s"],
+                  "delta_pct": on["ab_delta_pct"],
+                  "method": "paired-interleaved",
+                  "rounds": on["ab_rounds"],
+                  "window_steps": on["ab_window_steps"],
+                  "prefetch_depth": on.get("prefetch_depth"),
+                  "dispatch_lag": on.get("dispatch_lag")})
+
+        # The headline contract holds only for a FULL leg list (legs[0] is
+        # the DiffuSeq north star). Under BENCH_ONLY (iteration mode) the
+        # first surviving train config — if any — is reported under its own
+        # name, never as the north star. In a full run the headline value
+        # must come from the headline LEG specifically: if that leg
+        # errored, report null (its error row stays in configs) rather
+        # than silently promoting the next leg's numbers under the
+        # north-star label. (Selection logic lives in final_payload so the
+        # watchdog emits the same contract.)
+        print_final_once()
+    except BenchInterrupted:
+        # SIGTERM landed in the post-leg tail (delta-row emit / payload
+        # serialization): the rows are complete, so the contract — the
+        # final JSON always prints — still holds.
+        print_final_once()
+    finally:
+        # Restored only AFTER the final print: a soft kill in the tail
+        # must hit the BenchInterrupted handler above, never the default
+        # action (which would end the process with no final JSON).
+        signal.signal(signal.SIGTERM, prev_term)
 
 
 if __name__ == "__main__":

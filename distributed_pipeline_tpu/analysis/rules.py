@@ -11,6 +11,10 @@ GL005 recompile-hazard     jit built per iteration; shape-derived scalars
                            or f-strings flowing into jitted args
 GL006 raw-shard-map        jax.experimental.shard_map / check_rep= used
                            directly instead of utils/jax_compat
+GL007 host-sync-in-loop    float()/np.asarray/.item() on a jitted step's
+                           output inside the outer (untraced) training
+                           loop — a per-step host sync that defeats async
+                           dispatch (dispatch_lag)
 """
 
 from __future__ import annotations
@@ -574,3 +578,128 @@ class RawShardMap(Rule):
                             "check_rep= is the pre-0.6 spelling — call "
                             "through utils/jax_compat.shard_map with "
                             "check_vma= instead")
+
+
+# --------------------------------------------------------------------- GL007
+
+# conversions that block the host on an in-flight device value
+_GL007_NP_BLOCKERS = {"numpy.asarray", "numpy.array"}
+_GL007_BUILTINS = {"float", "int", "bool"}
+# method names whose call result is (very likely) a jitted step's output:
+# the trainer's own loop surface plus the conventional step-fn spellings
+_GL007_STEP_ATTRS = {"run_step", "forward_only", "train_step", "eval_step"}
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Base Name of a Subscript/Attribute chain (``m["loss"]`` -> ``m``,
+    ``out.loss`` -> ``out``); None for anything not rooted at a plain
+    name (so ``float(jax.device_get(m["loss"]))`` — the SANCTIONED
+    explicit-fetch spelling — never matches)."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class HostSyncInLoop(Rule):
+    """GL007: a blocking conversion (``float()``/``int()``,
+    ``np.asarray``/``np.array``, ``.item()``) applied to a jitted step's
+    output INSIDE the outer training loop. Unlike GL002 this code is not
+    traced — it runs, and it quietly serializes the pipeline: every
+    iteration the host stalls on the step it just dispatched, so async
+    dispatch (``dispatch_lag``) and device prefetch buy nothing. The
+    fix is to keep metrics as device scalars in the loop (the logger
+    fetches them in one batch at dump time) or fetch explicitly with
+    ``jax.device_get`` outside the loop."""
+
+    code = "GL007-host-sync-in-loop"
+    description = ("blocking conversion (float()/np.asarray/.item()) of a "
+                   "jitted step's output inside the outer training loop "
+                   "serializes async dispatch")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        reported: Set[int] = set()
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, _LOOP_NODES) or module.in_traced(loop):
+                continue
+            step_names = self._step_output_names(module, loop)
+            for node in ast.walk(loop):
+                if id(node) in reported or not isinstance(node, ast.Call):
+                    continue
+                hit = self._blocking_conversion(module, node, step_names)
+                if hit:
+                    reported.add(id(node))
+                    yield module.finding(
+                        self, node,
+                        f"{hit} blocks the host on the in-flight step "
+                        "every loop iteration — a per-step sync that "
+                        "defeats async dispatch (dispatch_lag) and device "
+                        "prefetch; keep it a device scalar (the logger "
+                        "batches the fetch at dump time) or device_get it "
+                        "once outside the loop")
+
+    @staticmethod
+    def _is_step_call(module: Module, call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _GL007_STEP_ATTRS:
+            return True
+        try:
+            callee = ast.unparse(func)
+        except Exception:  # pragma: no cover - defensive
+            return False
+        return callee in module.jitted_bindings
+
+    def _step_output_names(self, module: Module,
+                           loop: ast.AST) -> Set[str]:
+        """Names assigned anywhere in the loop body from a step-ish call
+        (``m = loop.run_step(...)``, ``out = compiled(...)`` for a known
+        jitted binding) — the values whose conversion blocks."""
+        names: Set[str] = set()
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            if not self._is_step_call(module, node.value):
+                continue
+            for t in node.targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        names.add(e.id)
+        return names
+
+    def _blocking_conversion(self, module: Module, call: ast.Call,
+                             step_names: Set[str]) -> Optional[str]:
+        """Description of the blocking conversion this call performs on a
+        step output, or None."""
+        func = call.func
+        # (step_output).item() / m["loss"].item()
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not call.args:
+            if self._operand_is_step_output(module, func.value, step_names):
+                return ".item() on a step output"
+            return None
+        if len(call.args) != 1:
+            return None
+        operand = call.args[0]
+        if not self._operand_is_step_output(module, operand, step_names):
+            return None
+        if isinstance(func, ast.Name) and func.id in _GL007_BUILTINS:
+            return f"{func.id}() on a step output"
+        fn = module.resolve(func)
+        if fn in _GL007_NP_BLOCKERS:
+            return f"{fn} on a step output"
+        return None
+
+    def _operand_is_step_output(self, module: Module, operand: ast.AST,
+                                step_names: Set[str]) -> bool:
+        root = _root_name(operand)
+        if root is not None:
+            return root in step_names
+        # direct form: float(loop.run_step(...)["loss"])
+        node = operand
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            node = node.value
+        return isinstance(node, ast.Call) and self._is_step_call(module,
+                                                                 node)

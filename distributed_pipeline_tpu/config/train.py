@@ -68,6 +68,17 @@ class GeneralSettings(S):
                 "recompile nothing), 'off' disables, else an explicit dir "
                 "shared across runs; exported to spawned workers as "
                 "JAX_COMPILATION_CACHE_DIR")
+    prefetch_depth: int = _(
+        2, "device-side input prefetch depth: keep N batches already "
+           "device_put onto the mesh (with the compiled step's sharding) "
+           "while the current step runs, so the TPU never waits on the "
+           "host transfer; 2 = classic double buffering, 0 disables "
+           "(exact-resume data order is identical either way)")
+    dispatch_lag: int = _(
+        1, "async metrics dispatch: fetch/log step N-k's device scalars "
+           "while step N dispatches instead of blocking on the step just "
+           "enqueued; logged values are exact, just k steps late (flushed "
+           "at eval/checkpoint/exit boundaries); 0 = eager")
 
 
 class DataSettings(S):

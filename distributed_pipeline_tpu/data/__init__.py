@@ -40,12 +40,15 @@ from .dataset import (
     SyntheticLMDataset,
     SyntheticSeq2SeqDataset,
 )
+from .device_prefetch import DeviceBatch, prefetch_to_device
 
 __all__ = [
     "load_data_from_args",
     "infinite_loader_from_iterable",
     "infinite_loader_from_object",
     "batch_iterator",
+    "prefetch_to_device",
+    "DeviceBatch",
     "CustomDataset",
     "JsonlSeq2SeqDataset",
     "SyntheticLMDataset",

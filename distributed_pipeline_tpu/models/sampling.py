@@ -301,8 +301,20 @@ def make_decode_callback(data: Iterator[Dict[str, np.ndarray]],
                          use_ema: str = ""):
     """An ``eval_callbacks`` entry: decode one batch and log ``decode_acc``
     (plus ``decode_acc_ema_<rate>`` when ``use_ema`` names an EMA rate).
-    The jitted sampler is built once on first call and reused."""
+    The jitted sampler is built once on first call and reused.
+
+    Guard-clean under ``--sanitize``'s ``jax.transfer_guard("disallow")``
+    (TrainLoop runs eval callbacks inside the guard): the base RNG key is
+    built here at wiring time, the batch/key/step land on the mesh via
+    explicit ``jax.device_put`` with mesh-wide shardings (an off-mesh
+    committed input would force a guarded implicit reshard at dispatch),
+    ``fold_in`` runs inside the jitted fn, and the accuracy comes back via
+    explicit ``jax.device_get``."""
+    from ..parallel.sharding import replicated, shard_batch
+
     cache: Dict[str, Any] = {}
+    base_key = jax.random.PRNGKey(0)  # eager seed transfer; must not run
+    # under the sanitizer guard, so build it at wiring time, not in-call
 
     def callback(loop) -> None:
         from ..utils import logger
@@ -310,25 +322,27 @@ def make_decode_callback(data: Iterator[Dict[str, np.ndarray]],
         wl = loop.workload
         if "batch" not in cache:  # NOT setdefault: its default arg would
             # pull + device-put a fresh batch on every call just to drop it
-            cache["batch"] = jax.tree_util.tree_map(jnp.asarray, next(data))
+            cache["batch"] = shard_batch(loop.mesh, next(data))
+            cache["key"] = jax.device_put(base_key, replicated(loop.mesh))
         batch = cache["batch"]
         if "fn" not in cache:
             if wl.family == "diffuseq":
                 cache["fn"] = jax.jit(
-                    lambda p, b, r: target_span_accuracy(
-                        diffuseq_sample(wl, p, b, r, sample_steps), b))
+                    lambda p, b, k, s: target_span_accuracy(
+                        diffuseq_sample(wl, p, b, jax.random.fold_in(k, s),
+                                        sample_steps), b))
             else:
                 cache["fn"] = jax.jit(
-                    lambda p, b, r: gpt2_decode_accuracy(wl, p, b,
-                                                         prompt_len or 0))
-        rng = jax.random.fold_in(jax.random.PRNGKey(0), loop.step)
+                    lambda p, b, k, s: gpt2_decode_accuracy(wl, p, b,
+                                                            prompt_len or 0))
+        step = jax.device_put(np.uint32(loop.step), replicated(loop.mesh))
         key = "decode_acc"
         params = loop.state.params
         if use_ema and use_ema in loop.state.ema:
             params = loop.state.ema[use_ema]
             key = f"decode_acc_ema_{use_ema}"
         with loop.mesh:
-            acc = cache["fn"](params, cache["batch"], rng)
-        logger.logkv(key, float(acc))
+            acc = cache["fn"](params, batch, cache["key"], step)
+        logger.logkv(key, float(jax.device_get(acc)))
 
     return callback

@@ -150,6 +150,12 @@ def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
         env["DPT_RUN_TIMESTAMP"] = run_timestamp
     if cache_dir:
         env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    # Steady-state-throughput knobs ride the env too (run/train.py checks
+    # DPT_PREFETCH_DEPTH / DPT_DISPATCH_LAG before its flags): inherited
+    # from this process's environ above, so a launcher-level override
+    # reaches every worker of every restart attempt — the one channel a
+    # --config_json ring (which rejects individual CLI flags) can be
+    # A/B'd through without minting a new config file.
     env.update({
         AUTORUN_ENV_FLAG: "1",
         "JAX_COORDINATOR_ADDRESS": coord,

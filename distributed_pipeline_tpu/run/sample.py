@@ -125,27 +125,37 @@ def main(ns: argparse.Namespace) -> dict:
                 top_k=ns.top_k, top_p=ns.top_p, rng=r)
     decode = jax.jit(_decode)
 
-    accs, losses, rows = [], [], []
+    accs, losses, golds, preds = [], [], [], []
     for i in range(ns.num_batches):
-        batch = jax.tree_util.tree_map(jnp.asarray, next(data))
+        host = next(data)
+        batch = jax.tree_util.tree_map(jnp.asarray, host)
         # distinct keys per consumer (graftlint GL001): one folded key
         # feeding both the decode sampler and the eval-loss noise draw
         # would correlate their randomness
         r_dec, r_loss = jax.random.split(jax.random.fold_in(rng, i))
         pred, acc = decode(params, batch, r_dec)
-        accs.append(float(acc))
-        losses.append(float(wl.compute_losses(params, batch,
-                                              r_loss)["loss"]))
+        # device scalars stay on device in the loop (graftlint GL007:
+        # float() here would block on each batch's decode, serializing
+        # the dispatch pipeline); ONE batched fetch happens below
+        accs.append(acc)
+        losses.append(wl.compute_losses(params, batch, r_loss)["loss"])
         if ns.out:
-            for gold, p_row in zip(
-                    jnp.asarray(batch["input_ids"]).tolist(),
-                    jnp.asarray(pred).tolist()):
-                rows.append({"gold": gold, "pred": p_row})
+            # pred token arrays DO leave the device per batch (explicit
+            # device_get — GL007's sanctioned spelling): a long --out run
+            # retaining every [batch, seq] decode output would grow
+            # device memory linearly. Gold tokens never left the host.
+            # Only the scalar metrics above stay async.
+            golds.append(host["input_ids"])
+            preds.append(jax.device_get(pred))
+    accs = [float(a) for a in jax.device_get(accs)]
+    losses = [float(l) for l in jax.device_get(losses)]
 
     if ns.out:
         with open(ns.out, "w") as f:
-            for row in rows:
-                f.write(json.dumps(row) + "\n")
+            for gold_b, pred_b in zip(golds, preds):
+                for gold, p_row in zip(gold_b.tolist(), pred_b.tolist()):
+                    f.write(json.dumps({"gold": gold, "pred": p_row})
+                            + "\n")
 
     result = {
         "step": step, "params": which,

@@ -163,6 +163,18 @@ def main(namespace: argparse.Namespace) -> None:
         eval_callbacks.append(make_decode_callback(
             decode_data, sample_steps=args.eval_decode_sample_steps))
 
+    # Steady-state knobs accept a launcher-env override (DPT_PREFETCH_DEPTH
+    # / DPT_DISPATCH_LAG): --config_json runs reject individual CLI flags,
+    # so the env is the one channel that can A/B prefetch across a whole
+    # worker ring (the launcher forwards both vars to every spawned
+    # worker) without minting a new config file.
+    # `or`: an empty-string env value (DPT_PREFETCH_DEPTH= python ...)
+    # means unset, not int("")
+    prefetch_depth = int(os.environ.get("DPT_PREFETCH_DEPTH")
+                         or args.prefetch_depth)
+    dispatch_lag = int(os.environ.get("DPT_DISPATCH_LAG")
+                       or args.dispatch_lag)
+
     loop = TrainLoop(
         model=workload,
         data=data,
@@ -189,6 +201,8 @@ def main(namespace: argparse.Namespace) -> None:
         warmup_steps=args.warmup_steps,
         keep_checkpoints=args.keep_checkpoints,
         sanitize=args.sanitize,
+        prefetch_depth=prefetch_depth,
+        dispatch_lag=dispatch_lag,
     )
     n_m = loop.n_params / 1e6
     logger.info(f"the parameter count is {loop.n_params} ({n_m:.1f}M)")

@@ -18,7 +18,8 @@ import jax
 
 __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
            "StepTimer", "mfu", "enable_persistent_compilation_cache",
-           "timed_lower_compile", "AOTStep", "RecompileMonitor"]
+           "timed_lower_compile", "AOTStep", "RecompileMonitor",
+           "StallBreakdown"]
 
 # Peak dense bf16 FLOP/s per chip (public spec sheets), matched IN ORDER
 # against jax's device_kind strings — real hardware reports e.g.
@@ -216,6 +217,56 @@ class RecompileMonitor(logging.Handler):
 
     def __exit__(self, *exc: Any) -> None:
         self.uninstall()
+
+
+class StallBreakdown:
+    """Per-step stall accounting: WHERE the host loop's wall time goes,
+    so "is the input pipeline the bottleneck" is a number, not a guess.
+
+    Four gauges, attributed by the trainer / device-prefetch wrapper:
+
+    * ``data_wait_s``   — blocked on the host iterator (batch assembly;
+      the thread-prefetch queue was empty when the loop asked);
+    * ``h2d_wait_s``    — blocked placing the batch on the mesh (the
+      ``device_put``/``shard_batch`` call; near-zero when transfers
+      overlap compute, the full copy cost on synchronous backends);
+    * ``dispatch_s``    — enqueueing the compiled step (trace-cache
+      lookup + argument handling; does NOT include device execution);
+    * ``device_step_s`` — trailing: wall time from a step's dispatch
+      returning to its outputs materializing, observed when the lagged
+      metrics fetch blocks on a k-steps-old output (``dispatch_lag``;
+      an upper bound on device execution — it includes queue wait).
+
+    ``add`` accumulates; ``lap`` returns the window's per-step means and
+    resets it (the ``log_interval`` cadence); ``totals`` is cumulative.
+    Gauges with no samples report 0.0 so every sink/bench row carries
+    all four keys.
+    """
+
+    GAUGES = ("data_wait_s", "h2d_wait_s", "dispatch_s", "device_step_s")
+
+    def __init__(self) -> None:
+        self._win = {g: [0.0, 0] for g in self.GAUGES}   # [sum, count]
+        self._tot = {g: [0.0, 0] for g in self.GAUGES}
+
+    def add(self, gauge: str, seconds: float) -> None:
+        for acc in (self._win[gauge], self._tot[gauge]):
+            acc[0] += seconds
+            acc[1] += 1
+
+    @staticmethod
+    def _means(accs) -> dict:
+        return {g: (s / n if n else 0.0) for g, (s, n) in accs.items()}
+
+    def lap(self) -> dict:
+        """Per-step means since the last lap; resets the window."""
+        out = self._means(self._win)
+        self._win = {g: [0.0, 0] for g in self.GAUGES}
+        return out
+
+    def totals(self) -> dict:
+        """Cumulative per-step means since construction."""
+        return self._means(self._tot)
 
 
 class StepTimer:

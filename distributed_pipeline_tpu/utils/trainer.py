@@ -30,10 +30,12 @@ of the reference scaffold finds the same control surface.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import time
-from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple, \
+    Union
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +45,7 @@ from flax import linen as nn
 from flax import struct
 from jax.sharding import Mesh
 
+from ..data.device_prefetch import DeviceBatch, prefetch_to_device
 from ..models import Workload
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import (
@@ -53,8 +56,8 @@ from ..parallel.sharding import (
 )
 from . import checkpoint as ckpt_lib
 from . import logger
-from .perf import AOTStep, RecompileMonitor, StepTimer, device_peak_flops, \
-    mfu, transformer_train_flops_per_token
+from .perf import AOTStep, RecompileMonitor, StallBreakdown, StepTimer, \
+    device_peak_flops, mfu, transformer_train_flops_per_token
 
 __all__ = ["TrainLoop", "TrainState", "update_ema"]
 
@@ -115,6 +118,8 @@ class TrainLoop:
         keep_checkpoints: int = 0,
         eval_batches_consumed: int = 0,
         sanitize: bool = False,
+        prefetch_depth: int = 0,
+        dispatch_lag: int = 0,
     ) -> None:
         # Time-to-signal accounting starts at construction: everything up
         # to the end of the first optimizer step (state init, restore,
@@ -154,6 +159,20 @@ class TrainLoop:
         self.profile_dir = profile_dir
         self._profile_window = (3, 8)  # [start, stop) steps after loop entry
         self._profiling = False
+
+        # Steady-state throughput layer (ISSUE 5): keep the device queue
+        # full. prefetch_depth > 0 wraps the data iterator so batches are
+        # device_put onto the mesh (with the step's exact sharding) while
+        # the previous step computes; dispatch_lag = k defers fetching a
+        # step's metric scalars until k later steps have dispatched, so
+        # the host never blocks on the step it just enqueued. Both default
+        # OFF here (the config layer turns them on for real runs) so the
+        # eager semantics tests rely on stay the default API behavior.
+        self.prefetch_depth = prefetch_depth
+        self.dispatch_lag = dispatch_lag
+        self.stalls = StallBreakdown()
+        # (loop step idx, dispatch-return timestamp, device metrics tree)
+        self._inflight: "collections.deque" = collections.deque()
 
         # Runtime sanitizer (the dynamic half of analysis/ graftlint):
         # count every XLA compile into the recompile_count gauge, and run
@@ -201,6 +220,16 @@ class TrainLoop:
 
         self._build_state(resume_checkpoint)
         self._build_step_fns()
+
+        # Device prefetch wraps the data stream AFTER the step fns exist
+        # (it places batches with _prepare's sharding — the layout the AOT
+        # step was compiled for). Wrapping only reorders WHEN transfers
+        # happen, never WHICH indices the underlying iterator draws, so
+        # skip_batches exact-resume is untouched.
+        if self.prefetch_depth > 0 and self.data is not None:
+            self.data = prefetch_to_device(
+                self.data, put=self._prepare, depth=self.prefetch_depth,
+                length_of=self.get_batch_length, stats=self.stalls)
 
         # Cumulative sample count via the get_batch_length hook; seeded from
         # the resumed step so the gauge is continuous across restarts.
@@ -275,13 +304,18 @@ class TrainLoop:
         self.n_params = wl.param_count(params)
         self.step = 0
 
-        restored = ckpt_lib.restore_resume_state(
-            self.checkpoint_dir,
-            abstract_params=_abstract_like(params),
-            ema_rates=self.ema_rates,
-            abstract_opt=_abstract_like(opt_state),
-            explicit_model_path=resume_checkpoint,
-        )
+        # Sanitize mode guards the restore too (the cold-path half of the
+        # checkpoint net): Orbax restores into the requested shardings via
+        # explicit placement, so an implicit transfer here means resume
+        # code regressed into a host round-trip.
+        with self._sanitize_guard():
+            restored = ckpt_lib.restore_resume_state(
+                self.checkpoint_dir,
+                abstract_params=_abstract_like(params),
+                ema_rates=self.ema_rates,
+                abstract_opt=_abstract_like(opt_state),
+                explicit_model_path=resume_checkpoint,
+            )
         if restored is not None:
             self.step = restored["step"]
             # One-time defensive copy: the jitted train step DONATES the
@@ -484,12 +518,43 @@ class TrainLoop:
         dict-of-dicts) override ONE method instead of the loop."""
         return int(len(jax.tree_util.tree_leaves(batch)[0]))
 
-    def run_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        """One optimizer step (reference run_step, trainer.py:198-201)."""
+    def next_batch(self) -> Union[Dict[str, np.ndarray], DeviceBatch]:
+        """Pull the next training batch, attributing host-iterator wait to
+        the ``data_wait_s`` stall gauge. With device prefetch on, the
+        wrapper attributes its own waits internally (this call returns a
+        buffered :class:`DeviceBatch` without double counting)."""
+        if self.prefetch_depth > 0:
+            return next(self.data)
+        t0 = time.perf_counter()
+        batch = next(self.data)
+        self.stalls.add("data_wait_s", time.perf_counter() - t0)
+        return batch
+
+    def run_step(self, batch: Union[Dict[str, np.ndarray], DeviceBatch]
+                 ) -> Dict[str, Any]:
+        """One optimizer step (reference run_step, trainer.py:198-201).
+
+        Accepts either a host batch (prepared + transferred here, the
+        eager path) or a :class:`DeviceBatch` from the prefetch wrapper
+        (already on the mesh — dispatch is all that's left). With
+        ``dispatch_lag > 0`` the returned metrics are the CURRENT step's
+        device scalars, but logging them is deferred: step N-k's metrics
+        are fetched/logged while step N runs, so the host never blocks on
+        the step it just enqueued (flush_metrics drains the tail)."""
         first = self.time_to_first_step_s is None
-        prepared = self._prepare(batch)
+        if isinstance(batch, DeviceBatch):
+            prepared = batch.arrays
+            n_items = batch.n_items
+        else:
+            t0 = time.perf_counter()
+            prepared = self._prepare(batch)
+            self.stalls.add("h2d_wait_s", time.perf_counter() - t0)
+            n_items = self.get_batch_length(batch)
+        t0 = time.perf_counter()
         with self.mesh, self._sanitize_guard():
             self.state, metrics = self._train_step(self.state, prepared)
+        dispatched = time.perf_counter()
+        self.stalls.add("dispatch_s", dispatched - t0)
         if first:
             # Block once so "time to first step" means a COMPLETED step
             # (async dispatch would otherwise stop the clock at enqueue).
@@ -499,18 +564,48 @@ class TrainLoop:
             logger.logkv("time_to_first_step_s",
                          round(self.time_to_first_step_s, 3))
         self.step += 1
-        self._samples += self.get_batch_length(batch) * jax.process_count()
+        self._samples += n_items * jax.process_count()
         self._timer.tick()
-        logger.logkvs_mean(metrics)
+        if self.dispatch_lag > 0:
+            self._inflight.append((self.step, dispatched, metrics))
+            while len(self._inflight) > self.dispatch_lag:
+                self._emit_lagged()
+        else:
+            logger.logkvs_mean(metrics)
         self.log_step()
         return metrics
+
+    def _emit_lagged(self) -> None:
+        """Fetch/log the OLDEST in-flight step's metrics. Blocking here —
+        k steps after dispatch — is where ``device_step_s`` is observed:
+        the span from that step's dispatch returning to its outputs
+        materializing (device execution + queue wait, a trailing upper
+        bound). The values logged are exactly the step's device scalars,
+        just late."""
+        step_idx, dispatched, metrics = self._inflight.popleft()
+        jax.block_until_ready(metrics["loss"])
+        self.stalls.add("device_step_s", time.perf_counter() - dispatched)
+        logger.logkvs_mean(metrics)
+
+    def flush_metrics(self) -> None:
+        """Drain every in-flight lagged metric (logged values become
+        complete up to the current step). Called before eval, before each
+        checkpoint save, and at loop exit, so anything that reads the
+        logs at those boundaries sees exact, fully-caught-up values."""
+        while self._inflight:
+            self._emit_lagged()
 
     def forward_only(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         """Eval pass without grads (reference forward_only trainer.py:223-228);
         metrics are logged under an ``eval_`` prefix."""
         # fold_in data must be uint32; offset eval streams away from the
-        # train stream (which folds in the raw step).
-        rng = jax.random.fold_in(self._base_rng, 0x7FFF0000 + self.step)
+        # train stream (which folds in the raw step). Replicate the key
+        # onto the mesh explicitly: a single-device key gets resharded
+        # implicitly at dispatch, which the sanitize guard (rightly) trips
+        # on when the eval step actually consumes it (diffuseq).
+        rng = jax.device_put(
+            jax.random.fold_in(self._base_rng, 0x7FFF0000 + self.step),
+            replicated(self.mesh))
         prepared = self._prepare(batch)
         with self.mesh, self._sanitize_guard():
             metrics = self._eval_step(self.state.params, prepared, rng)
@@ -534,6 +629,11 @@ class TrainLoop:
             logger.logkv("tokens_per_sec_per_chip",
                          round(tps / jax.device_count(), 1))
             logger.logkv("mfu", round(mfu(tps, self._flops_per_token), 4))
+        # Stall breakdown: mean seconds/step over the window for each of
+        # data_wait/h2d_wait/dispatch/device_step — "is the input pipeline
+        # the bottleneck" as a number in every sink.
+        for gauge, mean_s in self.stalls.lap().items():
+            logger.logkv(gauge, round(mean_s, 6))
 
     def _maybe_profile(self, loop_step: int) -> None:
         """Start/stop the jax.profiler trace window (steps counted from loop
@@ -561,7 +661,7 @@ class TrainLoop:
             while self.learning_steps <= 0 or self.step < self.learning_steps:
                 if self.profile_dir:
                     self._maybe_profile(loop_step)
-                batch = next(self.data)
+                batch = self.next_batch()
                 self.run_step(batch)
                 loop_step += 1
                 if self.log_interval > 0 and self.step % self.log_interval == 0:
@@ -569,6 +669,9 @@ class TrainLoop:
                     logger.dumpkvs()
                 if (self.eval_data is not None and self.eval_interval > 0
                         and self.step % self.eval_interval == 0):
+                    # Lagged metrics are flushed at eval boundaries so the
+                    # eval-step dump lines up with fully-logged train steps.
+                    self.flush_metrics()
                     self.forward_only(next(self.eval_data))
                     self.eval_batches_consumed += 1
                     # Reference runs callbacks on rank 0 only
@@ -578,8 +681,13 @@ class TrainLoop:
                     # multi-controller JAX every process must join such a
                     # computation — so ALL processes run the callbacks and
                     # output stays rank-gated in the logger sinks.
-                    for cb in self.eval_callbacks:
-                        cb(self)
+                    # Sanitize mode extends the transfer guard over the
+                    # callbacks: with async dispatch on, an implicit
+                    # transfer inside a callback is exactly the kind of
+                    # accidental per-eval sync the guard exists to catch.
+                    with self._sanitize_guard():
+                        for cb in self.eval_callbacks:
+                            cb(self)
                 if (self.save_interval > 0
                         and self.step % self.save_interval == 0):
                     self.save(wait=False)  # write overlaps training
@@ -587,10 +695,18 @@ class TrainLoop:
             if self._profiling:  # run ended (or raised) inside the window:
                 jax.profiler.stop_trace()  # flush the trace either way
                 self._profiling = False
-            # exception path too: drain the in-flight save before
-            # unwinding — a process exiting mid-commit can hang the other
-            # hosts in orbax's finalization barrier
-            self.wait_for_saves()
+            try:
+                # final flush: the last dispatch_lag steps' metrics are
+                # still in flight — without this they would never reach
+                # the sinks
+                self.flush_metrics()
+            finally:
+                # exception path too — including a flush that re-raises
+                # the poisoned in-flight step it blocks on: drain the
+                # in-flight save before unwinding — a process exiting
+                # mid-commit can hang the other hosts in orbax's
+                # finalization barrier
+                self.wait_for_saves()
         if self.save_interval <= 0 or self.step % self.save_interval != 0:
             self.save(wait=False)
         self.wait_for_saves()  # exit barrier: the last write must be durable
@@ -612,10 +728,18 @@ class TrainLoop:
         if not self.checkpoint_dir:
             logger.warn("no checkpoint_dir configured; skipping save")
             return
-        self._saver.save(
-            self.checkpoint_dir, self.step, self.state.params,
-            ema={r: self.state.ema[r] for r in self.ema_rates},
-            opt_state=self.state.opt_state, wait=wait)
+        # Checkpoint boundaries are metric-exact points: drain the lagged
+        # metric ring so the logs at a save reflect every step saved.
+        self.flush_metrics()
+        # Sanitize mode keeps the transfer guard up through the save
+        # scheduling: Orbax's device->host fetch is explicit (and proven
+        # guard-clean by test), so anything that trips here is an
+        # accidental implicit transfer sneaking into the save path.
+        with self._sanitize_guard():
+            self._saver.save(
+                self.checkpoint_dir, self.step, self.state.params,
+                ema={r: self.state.ema[r] for r in self.ema_rates},
+                opt_state=self.state.opt_state, wait=wait)
         ckpt_lib.save_meta(self.checkpoint_dir, self.step, {
             "eval_batches_consumed": self.eval_batches_consumed,
             "eval_interval": self.eval_interval,

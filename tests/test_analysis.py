@@ -27,11 +27,12 @@ def codes(findings):
 # ------------------------------------------------------------ rule catalog
 
 
-def test_catalog_has_all_six_rules():
+def test_catalog_has_all_seven_rules():
     got = {r.code for r in all_rules()}
     for expected in ("GL001-key-reuse", "GL002-host-sync",
                      "GL003-donation-after-use", "GL004-impure-jit",
-                     "GL005-recompile-hazard", "GL006-raw-shard-map"):
+                     "GL005-recompile-hazard", "GL006-raw-shard-map",
+                     "GL007-host-sync-in-loop"):
         assert expected in got
 
 
@@ -323,6 +324,72 @@ def test_jax_compat_itself_is_exempt(tmp_path):
         from jax.experimental.shard_map import shard_map
     """, name="utils/jax_compat.py")
     assert "GL006-raw-shard-map" not in codes(fs)
+
+
+# ------------------------------------------------------------------- GL007
+
+
+def test_host_sync_in_loop_on_step_outputs(tmp_path):
+    """Blocking conversions of a step output INSIDE the outer (untraced)
+    training loop serialize async dispatch — every spelling the rule
+    names: float(), np.asarray, .item(), and the direct-call form."""
+    fs = lint(tmp_path, """
+        import numpy as np
+        def train(loop, data):
+            for batch in data:
+                m = loop.run_step(batch)
+                loss = float(m["loss"])
+                arr = np.asarray(m["grad_norm"])
+                v = m["loss"].item()
+                direct = float(loop.run_step(batch)["loss"])
+    """)
+    got = [f for f in fs if f.rule == "GL007-host-sync-in-loop"]
+    assert len(got) == 4
+
+
+def test_host_sync_in_loop_jitted_binding(tmp_path):
+    """The rule also tracks outputs of a module-level jitted binding
+    called in the loop (the bench/measure shape)."""
+    fs = lint(tmp_path, """
+        import jax
+        run = jax.jit(lambda p, x: p * x)
+        def bench(params, batches):
+            for b in batches:
+                out = run(params, b)
+                total = float(out)
+    """)
+    assert "GL007-host-sync-in-loop" in codes(fs)
+
+
+def test_host_sync_in_loop_negatives(tmp_path):
+    """Sanctioned spellings stay clean: explicit jax.device_get inside
+    the loop, conversions of non-step values, and conversions AFTER the
+    loop (one sync per run, not per step)."""
+    fs = lint(tmp_path, """
+        import jax
+        def train(loop, data):
+            for batch in data:
+                m = loop.run_step(batch)
+                ok = float(jax.device_get(m["loss"]))
+                other = float(batch["x"])
+            final = float(m["loss"])
+    """)
+    assert "GL007-host-sync-in-loop" not in codes(fs)
+
+
+def test_host_sync_in_traced_loop_is_gl002_territory(tmp_path):
+    """A loop INSIDE traced code is GL002's jurisdiction — GL007 only
+    fires on the untraced outer loop (no double reporting)."""
+    fs = lint(tmp_path, """
+        import jax
+        @jax.jit
+        def step(engine, state, batches):
+            for b in batches:
+                m = engine.train_step(state, b)
+                x = float(m)
+            return x
+    """)
+    assert "GL007-host-sync-in-loop" not in codes(fs)
 
 
 # ----------------------------------------------------------- parse errors

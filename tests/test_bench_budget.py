@@ -86,7 +86,8 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert skipped, "1s budget must skip every non-headline leg"
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
-    assert len(final["configs"]) == 5
+    # (headline + prefetch A/B twin + noaccum + moe8 + moe8-cf1 + scan)
+    assert len(final["configs"]) == 6
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):

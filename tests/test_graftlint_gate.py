@@ -19,6 +19,9 @@ GATED_PATHS = [
     os.path.join(ROOT, "artifacts"),
     os.path.join(ROOT, "bench.py"),
     os.path.join(ROOT, "__graft_entry__.py"),
+    # the steady-state-throughput tests drive the trainer's outer loop
+    # directly — exactly where GL007 (host-sync-in-loop) hazards breed
+    os.path.join(ROOT, "tests", "test_device_prefetch.py"),
 ]
 
 

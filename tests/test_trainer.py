@@ -561,3 +561,75 @@ def test_sanitize_off_by_default(tmp_path):
     loop = make_loop(tmp_path)
     loop.run_step(next(loop.data))
     assert not loop.sanitize and loop.recompile_count == 0
+    # steady-state knobs are opt-in at the TrainLoop API level (the
+    # config layer turns them on for real runs)
+    assert loop.prefetch_depth == 0 and loop.dispatch_lag == 0
+
+
+def test_sanitize_covers_callbacks_and_checkpoint_roundtrip(tmp_path):
+    """ISSUE 5 satellite (ROADMAP open item): the --sanitize transfer
+    guard extends beyond step dispatch to eval callbacks and checkpoint
+    save/restore. A guard-legal callback (explicit device_get) runs
+    fine, saves scheduled under the guard land, a sanitized resume
+    restores them — and an IMPLICIT transfer inside a callback raises
+    instead of silently serializing the loop."""
+    seen = {"n": 0}
+
+    def cb(tl):
+        seen["n"] += 1
+        assert int(jax.device_get(tl.state.step)) == tl.step  # explicit: ok
+        if seen["n"] == 2:
+            # implicit host->device transfer: the guard must catch it
+            jax.jit(lambda x: x + 1)(np.ones(3))
+
+    loop = make_loop(tmp_path, learning_steps=2, eval_interval=1,
+                     save_interval=1, sanitize=True,
+                     eval_data=tiny_data("gpt2", 8, seed=6),
+                     eval_callbacks=[cb])
+    try:
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            loop.run_loop()
+    finally:
+        loop.stop_sanitizer()
+    assert seen["n"] == 2  # first (legal) callback ran; second tripped
+
+    # step 1's save was scheduled UNDER the guard and still landed —
+    # Orbax's device->host fetch is explicit, so sanitized saves work
+    assert (tmp_path / "model_000001").is_dir()
+
+    # restore path under the guard: a sanitized loop resumes the
+    # guarded-save checkpoint without tripping
+    loop2 = make_loop(tmp_path, sanitize=True)
+    try:
+        assert loop2.step == 1
+        m = loop2.run_step(next(loop2.data))
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+    finally:
+        loop2.stop_sanitizer()
+
+
+def test_shipped_decode_callback_is_guard_clean(tmp_path):
+    """Code-review regression: make_decode_callback used to build its
+    PRNGKey eagerly in-call and dispatch off-mesh args, tripping the
+    --sanitize transfer guard the moment eval callbacks ran under it.
+    The shipped callback must run guard-clean — diffuseq specifically,
+    because its sampler CONSUMES the rng (gpt2's jit prunes the unused
+    key arg, hiding the off-mesh reshard)."""
+    from distributed_pipeline_tpu.models.sampling import make_decode_callback
+
+    data = load_data_from_args("valid", batch_size=8,
+                               dataset="synthetic-seq2seq", seq_len=16,
+                               vocab_size=64, seed=0, deterministic=True)
+    cb = make_decode_callback(data, sample_steps=3)
+    loop = make_loop(tmp_path, fam="diffuseq", learning_steps=2,
+                     eval_interval=1, sanitize=True,
+                     eval_data=tiny_data("diffuseq", 8, seed=6),
+                     eval_callbacks=[cb])
+    try:
+        with logger.scoped_configure(dir=str(tmp_path / "logs"),
+                                     format_strs=["json"]):
+            loop.run_loop()  # would raise Disallowed...transfer pre-fix
+            d = logger.dumpkvs()
+        assert 0.0 <= d["decode_acc"] <= 1.0
+    finally:
+        loop.stop_sanitizer()
