@@ -8,6 +8,7 @@ from .base import (
     _,
     bool_from_string,
 )
+from .serve import ServeSettings
 from .train import (
     DataSettings,
     GeneralSettings,

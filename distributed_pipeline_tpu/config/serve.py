@@ -1,0 +1,72 @@
+"""Serving settings (``run/serve.py``).
+
+Same declarative config surface as training (config/base.py): every field
+is a ``--flag``, round-trips through JSON, and documents itself in
+``--help``. The knobs mirror the serving stack's layers — engine geometry
+(slots/pages/lengths), sampling, workload (prompt file or synthetic
+arrival process), and the sanitizer switch.
+"""
+
+from __future__ import annotations
+
+from .base import ArgparseCompatibleBaseModel as S
+from .base import item as _
+
+
+class ServeSettings(S):
+    """Continuous-batching decode service over a trained run directory."""
+
+    checkpoint_path: str = _(..., "run directory written by run.train")
+    step: int = _(0, "checkpoint step to load (0 = newest)")
+    ema: str = _("", "EMA rate to serve (e.g. 0.99); empty = raw params")
+
+    decode_slots: int = _(8, "compiled decode batch size: decode always "
+                             "runs at this many slots (inactive slots are "
+                             "masked), so the executable never "
+                             "re-specializes to occupancy")
+    page_size: int = _(16, "tokens per KV-cache page")
+    max_pages: int = _(0, "total pages in the per-layer KV pool (incl. the "
+                          "reserved trash page); 0 = full residency "
+                          "(decode_slots * ceil(max_len/page_size) + 1). "
+                          "Smaller pools admit fewer concurrent long "
+                          "requests instead of OOMing")
+    max_prompt_len: int = _(0, "compiled prefill length — prompts pad up "
+                               "to it (0 = max_len/2)")
+    max_len: int = _(0, "longest prompt+generation per slot "
+                        "(0 = the model's seq_len)")
+    max_new_tokens: int = _(64, "generation budget per request")
+    prefill_batch: int = _(0, "prompts prefilled per admission dispatch "
+                              "(0 = min(decode_slots, 8))")
+    decode_span: int = _(4, "tokens generated per decode dispatch (a "
+                            "lax.scan inside the executable): amortizes "
+                            "host dispatch over span tokens; admission "
+                            "happens at span granularity and a request "
+                            "ending mid-span wastes up to span-1 "
+                            "slot-steps")
+    dispatch_lag: int = _(2, "decode dispatches kept in flight before the "
+                             "host fetches tokens: bookkeeping overlaps "
+                             "device execution; EOS detection lags by "
+                             "this many dispatches")
+
+    temperature: float = _(0.0, "0 = greedy; > 0 samples")
+    top_k: int = _(0, "restrict sampling to the k most likely tokens")
+    top_p: float = _(0.0, "nucleus sampling mass (0 = off)")
+    seed: int = _(0, "sampling seed")
+    eos_id: int = _(-1, "finish a request early at this token id (-1 = "
+                        "off; observed one lagged step late)")
+
+    prompt_file: str = _("", "JSONL requests, one {\"prompt_ids\": [...]} "
+                             "per line (optional \"max_new_tokens\"); "
+                             "empty = synthetic workload")
+    synthetic_requests: int = _(32, "synthetic workload: request count")
+    synthetic_prompt_len: int = _(0, "synthetic prompt length "
+                                     "(0 = max_prompt_len)")
+    arrival_every_steps: int = _(0, "synthetic arrival process: enqueue "
+                                    "one request every N scheduler steps "
+                                    "(0 = all queued at start)")
+    out: str = _("", "write per-request JSONL results here")
+    sanitize: bool = _(False, "runtime sanitizer: count XLA compiles "
+                              "(recompile_count must stay 0 in steady "
+                              "state — prefill/decode compile exactly "
+                              "once) and disallow implicit host<->device "
+                              "transfers during dispatch")

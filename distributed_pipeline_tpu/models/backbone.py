@@ -50,18 +50,31 @@ class SelfAttention(nn.Module):
     ``cache_index=i`` writes position i and attends to cache[0..i] — O(L)
     work per generated token instead of a full O(L^2) re-forward. The
     caller threads ``cache_index``; no mutable step counter hides in the
-    module (jit/scany-friendly)."""
+    module (jit/scany-friendly).
+
+    ``paged_pages > 0`` (with ``decode=True``) switches the cache to the
+    PAGED layout behind the serving layer (serving/paged_kv.py): K/V live in
+    a shared pool of fixed-size pages (``pages_k``/``pages_v`` variables,
+    [paged_pages, page_size, H, Dh]) indirected through a per-slot
+    ``block_table`` [B, pages_per_slot] argument, and ``cache_index`` is a
+    PER-SLOT position vector [B] — each decode slot sits at its own depth,
+    which is what continuous batching needs. Page 0 is the trash page:
+    writes from padded/inactive slots land there and are never read (reads
+    are masked to each slot's live prefix)."""
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
     causal: bool = False
     attention_impl: str = "auto"
     decode: bool = False
+    paged_pages: int = 0
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray],
-                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_index: Optional[jnp.ndarray] = None,
+                 block_table: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         B, L, D = x.shape
         H = self.num_heads
         assert D % H == 0, f"hidden {D} not divisible by heads {H}"
@@ -74,12 +87,58 @@ class SelfAttention(nn.Module):
             (H, Dh, D), jnp.float32)
         qkv = jnp.einsum("bld,dthk->tbhlk", x, qkv_w.astype(self.dtype))
         q, k, v = qkv[0], qkv[1], qkv[2]
-        if self.decode:
+        if self.decode and self.paged_pages > 0:
+            if block_table is None:
+                raise ValueError("paged decode (paged_pages > 0) needs a "
+                                 "block_table")
+            o = self._paged_attention(q, k, v, pad_mask, cache_index,
+                                      block_table)
+        elif self.decode:
             o = self._cached_attention(q, k, v, pad_mask, cache_index)
         else:
+            if block_table is not None:
+                raise ValueError("block_table is only meaningful for paged "
+                                 "decode (decode=True, paged_pages > 0)")
             o = dot_product_attention(q, k, v, pad_mask, causal=self.causal,
                                       impl=self.attention_impl)
         return jnp.einsum("bhlk,hkd->bld", o, out_w.astype(self.dtype))
+
+    def _paged_attention(self, q, k, v, pad_mask, cache_index, block_table):
+        # function-level import: paged_kv is a leaf module (jax-only), so
+        # models <- serving here is a cycle-free convenience, same pattern
+        # as Block's moe import
+        from ..serving.paged_kv import gather_kv, write_prompt_kv, \
+            write_token_kv
+        B, H, L, Dh = q.shape
+        pk = self.variable("cache", "pages_k", jnp.zeros,
+                           (self.paged_pages, self.page_size, H, Dh), k.dtype)
+        pv = self.variable("cache", "pages_v", jnp.zeros,
+                           (self.paged_pages, self.page_size, H, Dh), v.dtype)
+        if L > 1:  # prefill: write the prompt's K/V into its slots' pages;
+            # attention itself runs on the local (contiguous) k/v — exactly
+            # the dense prefill computation, so logits match it bitwise
+            valid = pad_mask if pad_mask is not None else jnp.ones(
+                (B, L), jnp.int32)
+            pk.value = write_prompt_kv(pk.value, block_table, k, valid)
+            pv.value = write_prompt_kv(pv.value, block_table, v, valid)
+            return dot_product_attention(q, k, v, pad_mask, causal=True,
+                                         impl=self.attention_impl)
+        if cache_index is None or jnp.ndim(cache_index) != 1:
+            raise ValueError("paged single-token decode needs a per-slot "
+                             "cache_index vector [B]")
+        idx = jnp.asarray(cache_index, jnp.int32)
+        pk.value = write_token_kv(pk.value, block_table, k[:, :, 0], idx)
+        pv.value = write_token_kv(pv.value, block_table, v[:, :, 0], idx)
+        ks = gather_kv(pk.value, block_table)   # [B, H, Lmax, Dh]
+        vs = gather_kv(pv.value, block_table)
+        # Positions beyond each slot's own depth hold trash/stale pages;
+        # mask them (causality IS this mask for one query row). Masked
+        # entries contribute exact zeros to the softmax, so at equal padded
+        # length this is bit-identical to the dense cache path.
+        live = (jnp.arange(ks.shape[2])[None, :] <= idx[:, None]).astype(
+            jnp.int32)
+        return dot_product_attention(q, ks, vs, live, causal=False,
+                                     impl="xla")
 
     def _cached_attention(self, q, k, v, pad_mask, cache_index):
         B, H, L, Dh = q.shape
@@ -147,15 +206,21 @@ class Block(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_no_drop: bool = False
+    paged_pages: int = 0
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray],
-                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_index: Optional[jnp.ndarray] = None,
+                 block_table: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
         x = x + SelfAttention(self.num_heads, self.dtype, self.causal,
                               self.attention_impl, self.decode,
-                              name="attn")(h, pad_mask, cache_index)
+                              paged_pages=self.paged_pages,
+                              page_size=self.page_size,
+                              name="attn")(h, pad_mask, cache_index,
+                                           block_table)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
         if self.moe_experts > 0:
             from .moe import MoEMlp  # function-level: moe imports backbone
@@ -192,12 +257,19 @@ class TransformerBackbone(nn.Module):
     # GPipe pipeline streaming when the mesh has a pipe axis > 1
     pp_chunks: int = 4
     scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
+    paged_pages: int = 0  # serving: paged KV cache pool size (0 = dense)
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray] = None,
-                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_index: Optional[jnp.ndarray] = None,
+                 block_table: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         if self.scan_layers:
+            if block_table is not None or self.paged_pages > 0:
+                raise NotImplementedError(
+                    "paged decode needs per-layer named blocks; stacked "
+                    "(scan_layers) models use the dense cache path")
             if self.moe_experts > 0:
                 from .pipeline import MoEScanBlocks
                 x = MoEScanBlocks(
@@ -236,5 +308,8 @@ class TransformerBackbone(nn.Module):
                           moe_top_k=self.moe_top_k,
                           moe_capacity_factor=self.moe_capacity_factor,
                           moe_no_drop=self.moe_no_drop,
-                          name=f"block_{i}")(x, pad_mask, cache_index)
+                          paged_pages=self.paged_pages,
+                          page_size=self.page_size,
+                          name=f"block_{i}")(x, pad_mask, cache_index,
+                                             block_table)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x).astype(self.dtype)

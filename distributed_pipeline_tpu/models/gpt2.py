@@ -47,11 +47,14 @@ class GPT2Model(nn.Module):
     pp_schedule: str = "1f1b"  # training schedule under a pipe > 1 mesh
     pp_virtual: int = 2  # virtual stages/device (pp_schedule="interleaved")
     scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
+    paged_pages: int = 0  # serving: paged KV-cache pool size (0 = dense)
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray] = None,
-                 cache_index: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 cache_index: Optional[jnp.ndarray] = None,
+                 block_table: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         B, L = ids.shape
         word_emb = nn.Embed(
             self.vocab_size, self.hidden_size,
@@ -66,9 +69,14 @@ class GPT2Model(nn.Module):
                 nn.initializers.normal(0.02), (None, None)),
             (self.seq_len, self.hidden_size), jnp.float32)
         if cache_index is not None and L == 1:
-            pos = jax.lax.dynamic_slice(
-                pos_emb, (jnp.asarray(cache_index, jnp.int32), 0),
-                (1, self.hidden_size))[None]
+            idx = jnp.asarray(cache_index, jnp.int32)
+            if idx.ndim == 0:
+                pos = jax.lax.dynamic_slice(
+                    pos_emb, (idx, 0), (1, self.hidden_size))[None]
+            else:
+                # per-slot positions (continuous-batching decode): each
+                # slot sits at its own depth, so the embedding is a gather
+                pos = jnp.take(pos_emb, idx, axis=0)[:, None, :]
         else:
             pos = pos_emb[None, :L]
         h = (word_emb(ids) + pos).astype(self.dtype)
@@ -86,7 +94,10 @@ class GPT2Model(nn.Module):
                                 scan_layers=self.scan_layers,
                                 pp_chunks=self.pp_chunks,
                                 scan_unroll=self.scan_unroll,
-                                name="backbone")(h, pad_mask, cache_index)
+                                paged_pages=self.paged_pages,
+                                page_size=self.page_size,
+                                name="backbone")(h, pad_mask, cache_index,
+                                                 block_table)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the
         # HBM traffic of f32; softmax stats go to f32 downstream (ops/xent.py).
         return jnp.einsum("bld,vd->blv", h,

@@ -45,6 +45,28 @@ __all__ = [
 ]
 
 
+def _truncate_logits(l: jnp.ndarray, top_k: int, top_p: float) -> jnp.ndarray:
+    """Static top-k / nucleus truncation of f32 logits [..., V] — shared by
+    the batch decode picker here and the per-slot picker in
+    serving/engine.py (one implementation, so one-shot and served sampling
+    truncate identically)."""
+    if top_k > 0:
+        # clamp: top_k >= vocab means "no truncation", not a trace error
+        k = min(top_k, l.shape[-1])
+        kth = jax.lax.top_k(l, k)[0][..., -1:]  # [..., 1]
+        l = jnp.where(l < kth, -jnp.inf, l)
+    if 0.0 < top_p < 1.0:
+        sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        # smallest prefix with cumulative mass >= top_p; the token that
+        # crosses the threshold stays in
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf),
+                         axis=-1, keepdims=True)
+        l = jnp.where(l < cutoff, -jnp.inf, l)
+    return l
+
+
 def _next_token_fn(temperature: float, top_k: int, top_p: float,
                    rng: Optional[jax.Array]):
     """Token picker for one decode step: ``(logits [B, V], position) ->
@@ -58,21 +80,8 @@ def _next_token_fn(temperature: float, top_k: int, top_p: float,
         raise ValueError("stochastic decoding (temperature > 0) needs rng")
 
     def pick(logits: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
-        l = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            # clamp: top_k >= vocab means "no truncation", not a trace error
-            k = min(top_k, l.shape[-1])
-            kth = jax.lax.top_k(l, k)[0][..., -1:]  # [B, 1]
-            l = jnp.where(l < kth, -jnp.inf, l)
-        if 0.0 < top_p < 1.0:
-            sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_l, axis=-1)
-            # smallest prefix with cumulative mass >= top_p; the token that
-            # crosses the threshold stays in
-            keep = jnp.cumsum(probs, axis=-1) - probs < top_p
-            cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf),
-                             axis=-1, keepdims=True)
-            l = jnp.where(l < cutoff, -jnp.inf, l)
+        l = _truncate_logits(logits.astype(jnp.float32) / temperature,
+                             top_k, top_p)
         return jax.random.categorical(jax.random.fold_in(rng, i), l, axis=-1)
 
     return pick

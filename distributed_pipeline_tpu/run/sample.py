@@ -59,6 +59,53 @@ def create_parser() -> argparse.ArgumentParser:
     return p
 
 
+def load_run(run_dir: str, step: int = 0, ema: str = "", mesh=None):
+    """Recover (workload, params, targs, step, which) from a run directory:
+    model config from its ``training_args.json`` snapshot, raw or EMA
+    params from the newest (or explicit-step) checkpoint. With ``mesh``,
+    params land sharded per the model's logical rules (FSDP/TP), so every
+    chip holds its shard instead of device 0 holding everything. Shared by
+    ``run.sample`` and ``run.serve`` — one loading (and placement) path
+    for every checkpoint consumer."""
+    import jax
+
+    from ..models import create_model_from_config
+    from ..parallel.sharding import param_shardings
+    from ..utils import checkpoint as ckpt_lib
+    from ..utils import logger
+
+    args_file = os.path.join(run_dir, "training_args.json")
+    with open(args_file) as f:
+        targs = json.load(f)
+
+    wl = create_model_from_config(**targs)
+    boxed = jax.eval_shape(wl.init_params, jax.random.PRNGKey(0))
+    from flax import linen as nn
+    abstract = nn.meta.unbox(boxed)
+
+    if step:
+        model_path = os.path.join(run_dir, f"model_{step:06d}")
+    else:
+        model_path = ckpt_lib.find_resume_checkpoint(run_dir)
+        if not model_path:
+            raise FileNotFoundError(f"no model_* checkpoint under {run_dir}")
+    step = ckpt_lib.parse_step_from_name(model_path) or 0
+    if ema:
+        ema_path = ckpt_lib.find_ema_checkpoint(run_dir, step, ema)
+        if not ema_path:
+            raise FileNotFoundError(
+                f"no ema_{ema}_{step:06d} under {run_dir}")
+        params = ckpt_lib.restore_checkpoint(ema_path, abstract)
+        which = f"ema_{ema}"
+    else:
+        params = ckpt_lib.restore_checkpoint(model_path, abstract)
+        which = "raw"
+    if mesh is not None:
+        params = jax.device_put(params, param_shardings(mesh, boxed))
+    logger.info(f"loaded {which} params from step {step} ({model_path})")
+    return wl, params, targs, step, which
+
+
 def main(ns: argparse.Namespace) -> dict:
     if (ns.top_k > 0 or ns.top_p > 0) and ns.temperature <= 0:
         raise SystemExit(
@@ -66,101 +113,116 @@ def main(ns: argparse.Namespace) -> dict:
             "default --temperature 0 decoding is greedy and they would be "
             "silently ignored. Pass --temperature > 0.")
     import jax
-    import jax.numpy as jnp
+    import numpy as np
 
     from ..data import load_data_from_args
-    from ..models import create_model_from_config
     from ..models.sampling import (
         diffuseq_sample_mbr,
         gpt2_decode_and_score,
         target_span_accuracy,
     )
-    from ..utils import checkpoint as ckpt_lib
-    from ..utils import logger
+    from ..parallel import make_mesh
+    from ..parallel.sharding import shard_batch
 
-    run_dir = ns.checkpoint_path
-    args_file = os.path.join(run_dir, "training_args.json")
-    with open(args_file) as f:
-        targs = json.load(f)
-
-    wl = create_model_from_config(**targs)
+    # Mesh placement (like the decode eval callback): params restore
+    # FSDP/TP-sharded per the model's logical rules (load_run) and batches
+    # land sharded over the data axes via shard_batch — on a multi-chip
+    # mesh sampling uses every chip instead of silently replicating the
+    # whole computation on device 0 (a bare jnp.asarray batch did that).
+    mesh = make_mesh()
+    wl, params, targs, step, which = load_run(ns.checkpoint_path, ns.step,
+                                              ns.ema, mesh=mesh)
     data = load_data_from_args(
         ns.split, **{**targs, "batch_size": ns.batch_size,
                      "deterministic": True})
-
     rng = jax.random.PRNGKey(ns.seed)
-    abstract = jax.eval_shape(wl.init_params, rng)
-    from flax import linen as nn
-    abstract = nn.meta.unbox(abstract)
 
-    if ns.step:
-        model_path = os.path.join(run_dir, f"model_{ns.step:06d}")
-    else:
-        model_path = ckpt_lib.find_resume_checkpoint(run_dir)
-        if not model_path:
-            raise FileNotFoundError(f"no model_* checkpoint under {run_dir}")
-    step = ckpt_lib.parse_step_from_name(model_path) or 0
-    if ns.ema:
-        ema_path = ckpt_lib.find_ema_checkpoint(run_dir, step, ns.ema)
-        if not ema_path:
-            raise FileNotFoundError(
-                f"no ema_{ns.ema}_{step:06d} under {run_dir}")
-        params = ckpt_lib.restore_checkpoint(ema_path, abstract)
-        which = f"ema_{ns.ema}"
-    else:
-        params = ckpt_lib.restore_checkpoint(model_path, abstract)
-        which = "raw"
-    logger.info(f"loaded {which} params from step {step} ({model_path})")
-
+    plen = ns.prompt_len or wl.seq_len // 2
+    # GPT-2 named-blocks models decode through the SERVING path — the same
+    # prefill/decode AOT executables run/serve.py uses (one code path for
+    # one-shot and served decode); stacked (scan_layers) models keep the
+    # monolithic gpt2_decode jit (no paged cache there).
+    use_engine = (wl.family == "gpt2"
+                  and not getattr(wl.model, "scan_layers", False))
     if wl.family == "diffuseq":
         def _decode(p, b, r):
             pred = diffuseq_sample_mbr(wl, p, b, r, ns.mbr,
                                        ns.sample_steps,
                                        clamp=not ns.no_clamp)
             return pred, target_span_accuracy(pred, b)
-    else:
-        def _decode(p, b, r):
-            return gpt2_decode_and_score(
-                wl, p, b, ns.prompt_len, temperature=ns.temperature,
-                top_k=ns.top_k, top_p=ns.top_p, rng=r)
-    decode = jax.jit(_decode)
+        decode = jax.jit(_decode)
+    elif not use_engine:
+        decode = jax.jit(lambda p, b, r: gpt2_decode_and_score(
+            wl, p, b, ns.prompt_len, temperature=ns.temperature,
+            top_k=ns.top_k, top_p=ns.top_p, rng=r))
+    server = None
+    eval_loss = jax.jit(
+        lambda p, b, r: wl.compute_losses(p, b, r)["loss"])
 
     accs, losses, golds, preds = [], [], [], []
-    for i in range(ns.num_batches):
+    for i in range(max(ns.num_batches, 0)):
         host = next(data)
-        batch = jax.tree_util.tree_map(jnp.asarray, host)
+        batch = shard_batch(mesh, host)
         # distinct keys per consumer (graftlint GL001): one folded key
         # feeding both the decode sampler and the eval-loss noise draw
         # would correlate their randomness
         r_dec, r_loss = jax.random.split(jax.random.fold_in(rng, i))
-        pred, acc = decode(params, batch, r_dec)
-        # device scalars stay on device in the loop (graftlint GL007:
-        # float() here would block on each batch's decode, serializing
-        # the dispatch pipeline); ONE batched fetch happens below
-        accs.append(acc)
-        losses.append(wl.compute_losses(params, batch, r_loss)["loss"])
-        if ns.out:
-            # pred token arrays DO leave the device per batch (explicit
-            # device_get — GL007's sanctioned spelling): a long --out run
-            # retaining every [batch, seq] decode output would grow
-            # device memory linearly. Gold tokens never left the host.
-            # Only the scalar metrics above stay async.
-            golds.append(host["input_ids"])
-            preds.append(jax.device_get(pred))
+        if use_engine:
+            if server is None:
+                from ..serving import DecodeServer
+                # the per-batch key arrives via one_shot_decode's set_rng;
+                # construction only fixes the executables' shapes
+                server = DecodeServer(
+                    wl, params, decode_slots=ns.batch_size,
+                    page_size=min(wl.seq_len, 64), max_prompt_len=wl.seq_len,
+                    max_len=wl.seq_len, prefill_batch=ns.batch_size,
+                    temperature=ns.temperature, top_k=ns.top_k,
+                    top_p=ns.top_p, seed=ns.seed, mesh=mesh)
+            from ..serving import one_shot_decode
+            pred_np = one_shot_decode(wl, params, host["input_ids"], plen,
+                                      rng=r_dec, server=server)
+            # generated-span accuracy (gpt2_decode_and_score semantics),
+            # host-side: the prediction is already host numpy
+            m = ((np.arange(wl.seq_len)[None, :] >= plen)
+                 * host["pad_mask"]).astype(np.float64)
+            hit = (pred_np == host["input_ids"]).astype(np.float64)
+            accs.append(float((hit * m).sum() / max(m.sum(), 1.0)))
+            if ns.out:
+                golds.append(host["input_ids"])
+                preds.append(pred_np)
+        else:
+            with mesh:
+                pred, acc = decode(params, batch, r_dec)
+            # device scalars stay on device in the loop (graftlint GL007:
+            # float() here would block on each batch's decode, serializing
+            # the dispatch pipeline); ONE batched fetch happens below
+            accs.append(acc)
+            if ns.out:
+                # pred token arrays DO leave the device per batch (explicit
+                # device_get — GL007's sanctioned spelling): a long --out
+                # run retaining every [batch, seq] decode output would grow
+                # device memory linearly. Gold tokens never left the host.
+                golds.append(host["input_ids"])
+                preds.append(jax.device_get(pred))
+        with mesh:
+            losses.append(eval_loss(params, batch, r_loss))
     accs = [float(a) for a in jax.device_get(accs)]
     losses = [float(l) for l in jax.device_get(losses)]
 
     if ns.out:
         with open(ns.out, "w") as f:
             for gold_b, pred_b in zip(golds, preds):
-                for gold, p_row in zip(gold_b.tolist(), pred_b.tolist()):
+                for gold, p_row in zip(np.asarray(gold_b).tolist(),
+                                       np.asarray(pred_b).tolist()):
                     f.write(json.dumps({"gold": gold, "pred": p_row})
                             + "\n")
 
     result = {
         "step": step, "params": which,
-        "decode_acc": sum(accs) / len(accs),
-        "eval_loss": sum(losses) / len(losses),
+        # --num_batches 0 is a config-check / load-only run: no batches
+        # means no metrics, reported as null instead of a ZeroDivisionError
+        "decode_acc": sum(accs) / len(accs) if accs else None,
+        "eval_loss": sum(losses) / len(losses) if losses else None,
         "num_batches": ns.num_batches, "batch_size": ns.batch_size,
     }
     print(json.dumps(result))

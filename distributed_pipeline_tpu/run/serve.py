@@ -1,0 +1,166 @@
+"""Serving entry point: continuous-batching decode over a trained run.
+
+``run/sample.py`` is a one-shot batch script — it decodes N fixed batches
+and exits. This entry serves TRAFFIC: requests (a JSONL prompt file or a
+synthetic arrival process) stream through a :class:`serving.DecodeServer`
+whose compiled decode batch stays continuously full — prefill/decode as
+separately AOT-compiled executables over the paged KV cache, free slots
+re-admitting queued requests every step (ROADMAP open item 1).
+
+    python -m distributed_pipeline_tpu.run.serve --checkpoint_path RUNDIR \
+        --decode_slots 64 --page_size 16 --max_new_tokens 128
+    python -m distributed_pipeline_tpu.run.serve --checkpoint_path RUNDIR \
+        --prompt_file prompts.jsonl --out results.jsonl --sanitize true
+
+stdout carries one machine-readable JSON summary (throughput, TTFT
+percentiles, compile split, recompile count); progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..config.serve import ServeSettings
+
+
+def create_parser() -> argparse.ArgumentParser:
+    return ServeSettings.to_argparse()
+
+
+def _load_requests(settings: ServeSettings, max_prompt_len: int,
+                   vocab_size: int):
+    """(prompt int32 [L], max_new_tokens) pairs from the prompt file, or a
+    synthetic workload of random prompts."""
+    import numpy as np
+
+    if settings.prompt_file:
+        out = []
+        with open(settings.prompt_file) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                prompt = np.asarray(row["prompt_ids"], np.int32)
+                if prompt.shape[0] > max_prompt_len:
+                    # keep the TAIL — the context a continuation wants —
+                    # and say so, rather than crashing the whole run on
+                    # one long prompt
+                    print(f"# serve: truncating a {prompt.shape[0]}-token "
+                          f"prompt to the last {max_prompt_len}",
+                          file=sys.stderr)
+                    prompt = prompt[-max_prompt_len:]
+                out.append((np.minimum(prompt, vocab_size - 1),
+                            int(row.get("max_new_tokens",
+                                        settings.max_new_tokens))))
+        return out
+    rng = np.random.default_rng(settings.seed)
+    plen = min(settings.synthetic_prompt_len or max_prompt_len,
+               max_prompt_len)
+    return [(rng.integers(4, vocab_size, (plen,)).astype(np.int32), 0)
+            for _ in range(settings.synthetic_requests)]
+
+
+def main(ns: argparse.Namespace) -> dict:
+    settings = ServeSettings.from_argparse(ns)
+    import numpy as np
+
+    from ..parallel import make_mesh
+    from ..serving import DecodeServer
+    from ..utils import logger
+    from .sample import load_run
+
+    mesh = make_mesh()
+    wl, params, _targs, step, which = load_run(
+        settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
+
+    max_len = settings.max_len or wl.seq_len
+    max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
+    server = DecodeServer(
+        wl, params, decode_slots=settings.decode_slots,
+        page_size=settings.page_size, max_pages=settings.max_pages,
+        max_prompt_len=max_prompt_len, max_len=max_len,
+        prefill_batch=settings.prefill_batch,
+        decode_span=settings.decode_span,
+        dispatch_lag=settings.dispatch_lag,
+        temperature=settings.temperature, top_k=settings.top_k,
+        top_p=settings.top_p, seed=settings.seed,
+        eos_id=settings.eos_id if settings.eos_id >= 0 else None,
+        mesh=mesh, sanitize=settings.sanitize)
+
+    pending = _load_requests(settings, max_prompt_len, wl.model.vocab_size)
+    logger.info(f"serving {len(pending)} requests on {settings.decode_slots} "
+                f"slots (page_size={settings.page_size}, "
+                f"pool={server.mgr.num_pages} pages)")
+
+    t0 = time.perf_counter()
+    submitted = []
+    cadence = settings.arrival_every_steps
+    steps = 0
+    warm_compiles = None  # XLA compiles up to the first fetched token:
+    # prefill+decode (and init fills) have all built by then, so any
+    # growth past this snapshot is a steady-state recompile — the
+    # regression the gauge exists to catch
+    try:  # submits included: a bad request must still stop_sanitizer
+        if cadence <= 0:  # saturating workload: everything queued up front
+            for prompt, n in pending:
+                submitted.append(server.submit(
+                    prompt, n or settings.max_new_tokens))
+            pending = []
+        while pending or server.busy:
+            if pending and steps % cadence == 0:
+                prompt, n = pending.pop(0)
+                submitted.append(server.submit(
+                    prompt, n or settings.max_new_tokens))
+            server.step()
+            if warm_compiles is None and server.tokens_fetched > 0:
+                warm_compiles = server.recompile_count
+            steps += 1
+        server.drain()
+    finally:
+        recompiles = server.stop_sanitizer()
+    wall_s = time.perf_counter() - t0
+
+    if settings.out:
+        with open(settings.out, "w") as f:
+            for req in submitted:
+                f.write(json.dumps({
+                    "id": req.id, "prompt": req.prompt.tolist(),
+                    "tokens": req.tokens,
+                    "ttft_s": round(req.ttft_s or 0.0, 4)}) + "\n")
+
+    ttft = server.ttft.summary()
+    result = {
+        "step": step, "params": which,
+        "requests": len(submitted),
+        "decode_tokens": server.tokens_fetched,
+        # replicated decode state: every chip runs the same step, so the
+        # service rate IS the per-chip rate (dividing by device_count
+        # would understate it — same reasoning as bench.measure_decode)
+        "decode_tokens_per_s_per_chip": round(
+            server.tokens_fetched / max(wall_s, 1e-9), 1),
+        "time_to_first_token_s": round(ttft["mean"], 4),
+        "ttft_p50_s": round(ttft["p50"], 4),
+        "ttft_p95_s": round(ttft["p95"], 4),
+        "decode_steps": server.decode_steps,
+        "prefill_steps": server.prefill_steps,
+        "decode_slots": settings.decode_slots,
+        "page_size": settings.page_size,
+        "compile_time_s": round(server.compile_time_s, 3),
+        "wall_s": round(wall_s, 2),
+    }
+    if settings.sanitize:
+        # steady-state growth past the warm snapshot must be 0: the two
+        # phase executables compile exactly once, during warmup
+        result["recompile_count"] = (recompiles - warm_compiles
+                                     if warm_compiles is not None
+                                     else recompiles)
+        result["xla_compiles_total"] = recompiles
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main(create_parser().parse_args())

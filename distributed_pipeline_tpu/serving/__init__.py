@@ -1,0 +1,30 @@
+"""Serving subsystem: prefill/decode AOT split, paged KV cache, and a
+continuous-batching scheduler (ROADMAP open item 1 — the "millions of
+users, heavy traffic" direction).
+
+Layers, bottom up:
+
+* :mod:`.paged_kv`   — pure-XLA page ops (scatter/gather against a shared
+  page pool + block tables) and the host-side :class:`PageManager`;
+* :mod:`.engine`     — :class:`DecodeEngine`: ``prefill`` and
+  ``decode_step`` as two separately AOT-compiled executables with pinned
+  shardings and per-slot positions, over the paged cache;
+* :mod:`.scheduler`  — :class:`DecodeServer`: continuous batching (admit
+  into free slots every step, decode always at the compiled slot count),
+  count-based completion, lagged token fetch so host bookkeeping overlaps
+  device steps, and TTFT/throughput gauges.
+
+Entry points: ``run/serve.py`` serves a prompt stream; ``run/sample.py``
+routes one-shot GPT-2 decoding through :func:`one_shot_decode` — one code
+path for one-shot and served decode.
+"""
+
+from .engine import DecodeEngine
+from .paged_kv import TRASH_PAGE, PageManager, gather_kv, write_prompt_kv, \
+    write_token_kv
+from .scheduler import DecodeServer, Request, one_shot_decode
+
+__all__ = [
+    "DecodeEngine", "DecodeServer", "Request", "PageManager", "TRASH_PAGE",
+    "gather_kv", "write_prompt_kv", "write_token_kv", "one_shot_decode",
+]

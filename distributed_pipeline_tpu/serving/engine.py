@@ -1,0 +1,331 @@
+"""DecodeEngine: the prefill/decode phase split as two AOT executables.
+
+``models/sampling.py::gpt2_decode`` is one monolithic jit: prefill and the
+whole generation fori_loop compile together, the loop runs in lockstep for
+the batch, and a new prompt means a new full trace. Serving wants the two
+phases APART (the standard TPU serving recipe — PAPERS: "Fine-Tuning and
+Serving Gemma 4 31B on Google Cloud TPU"):
+
+* ``prefill``     — one causal forward over a fixed-shape prompt batch that
+  writes the prompts' K/V into the paged pool, picks each request's first
+  token, and merges it into the decode state at the requests' target slots;
+* ``decode_step`` — ONE token for every decode slot: per-slot positions
+  (each slot at its own depth), paged attention over each slot's live
+  prefix, in-executable sampling, functional state out.
+
+Both are compiled exactly once via the same ``lower()/compile()`` machinery
+the trainer uses (utils/perf.AOTStep, PR 3) with pinned ``out_shardings``
+(under a mesh) so no hidden step-2 recompile can sneak in —
+``compile_time_s`` is surfaced per executable and the sanitizer's
+``recompile_count`` stays 0 across a served run. State (paged KV pool,
+token/position vectors) is a functional chain: each call consumes the
+previous call's outputs, the big cache buffer is donated, and the host only
+ever touches state through explicit ``device_put``/``device_get`` — so the
+whole engine runs clean under ``jax.transfer_guard("disallow")``.
+
+The scheduler (serving/scheduler.py) drives this engine; a fused
+flash-decode Pallas kernel later replaces the gather inside
+``decode_step`` without touching this seam (ROADMAP item 4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.sampling import _truncate_logits
+from ..utils.perf import AOTStep
+
+__all__ = ["DecodeEngine"]
+
+
+def _slot_picker(temperature: float, top_k: int, top_p: float):
+    """Per-slot token picker ``(logits [*, V], positions [*], slots [*],
+    rng) -> int32 [*]``. Greedy at temperature <= 0; otherwise categorical
+    with the SAME truncation as the batch decoder (models/sampling.py) and
+    the key folded per (slot, position) — position alone would hand every
+    slot at the same depth the identical Gumbel noise, making duplicate
+    prompts decode identical "samples". Prefill rows fold by their TARGET
+    slot, so a request's sampling stream is consistent from its first
+    token through every decode step in that slot."""
+    if temperature <= 0.0:
+        return lambda logits, pos, slots, rng: jnp.argmax(
+            logits, axis=-1).astype(jnp.int32)
+
+    def pick(logits: jnp.ndarray, pos: jnp.ndarray, slots: jnp.ndarray,
+             rng: jax.Array) -> jnp.ndarray:
+        l = _truncate_logits(logits.astype(jnp.float32) / temperature,
+                             top_k, top_p)
+        keys = jax.vmap(lambda s, p: jax.random.fold_in(
+            jax.random.fold_in(rng, s), p))(slots, pos)
+        return jax.vmap(jax.random.categorical)(keys, l).astype(jnp.int32)
+
+    return pick
+
+
+class DecodeEngine:
+    """Device half of the serving stack: paged-cache decode state plus the
+    two AOT executables that advance it.
+
+    Parameters
+    ----------
+    workload, params : the model (named-blocks GPT-2 family) and its live
+        parameter tree (passed through untouched — whatever sharding they
+        carry is what the executables compile against).
+    decode_slots : compiled decode batch size S. Decode ALWAYS runs at S
+        (inactive slots write to the trash page and their outputs are
+        ignored) — the executable never re-specializes to occupancy.
+    page_size, max_pages : paged KV pool geometry, per layer.
+    max_prompt_len : compiled prefill length (prompts pad up to it).
+    max_len : longest prompt+generation a slot can hold (caps the block
+        table width; <= the model's trained seq_len for position bounds).
+    prefill_batch : compiled prefill batch size (queued prompts batch
+        opportunistically up to it; short admissions pad with dummy rows).
+    decode_span : tokens generated per decode DISPATCH (a lax.scan of
+        decode steps inside the executable, token chain on device). Host
+        dispatch cost amortizes over span tokens — the lever when steps
+        are sub-millisecond and the host loop is the bottleneck. Slots
+        whose budget ends mid-span overshoot harmlessly (writes stay in
+        their own reserved pages or the trash page; outputs past budget
+        are discarded at fetch) at the cost of up to span-1 wasted
+        slot-steps, and admission happens at span granularity.
+    """
+
+    def __init__(self, workload, params, *, decode_slots: int,
+                 page_size: int, max_pages: int, max_prompt_len: int,
+                 max_len: int = 0, prefill_batch: int = 0,
+                 decode_span: int = 1,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 rng: Optional[jax.Array] = None, seed: int = 0,
+                 mesh=None, transfer_guard: bool = False,
+                 on_compile: Optional[Callable[[str, float], None]] = None):
+        model = workload.model
+        if workload.family != "gpt2":
+            raise ValueError(f"DecodeEngine serves the gpt2 (causal LM) "
+                             f"family, got {workload.family!r}")
+        if getattr(model, "scan_layers", False):
+            raise NotImplementedError(
+                "paged decode needs per-layer named blocks; scan_layers "
+                "models decode through models/sampling.py::gpt2_decode")
+        max_len = max_len or workload.seq_len
+        if not 1 <= max_len <= workload.seq_len:
+            raise ValueError(f"max_len {max_len} must be in [1, seq_len="
+                             f"{workload.seq_len}] (position table bound)")
+        if not 2 <= max_prompt_len <= max_len:
+            # >= 2: a length-1 prefill is shape-ambiguous with a decode step
+            raise ValueError(f"max_prompt_len {max_prompt_len} must be in "
+                             f"[2, max_len={max_len}]")
+        self.decode_slots = decode_slots
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.max_prompt_len = max_prompt_len
+        self.max_len = max_len
+        self.pages_per_slot = -(-max_len // page_size)
+        self.prefill_batch = prefill_batch or min(decode_slots, 8)
+        if decode_span < 1:
+            raise ValueError(f"decode_span must be >= 1, got {decode_span}")
+        self.decode_span = decode_span
+        if max_pages < 2:
+            raise ValueError(f"max_pages must be >= 2 (page 0 is the trash "
+                             f"page), got {max_pages}")
+        self.mesh = mesh
+        self._guard = transfer_guard
+        self.params = params
+        self.compile_time_s = 0.0
+        self._on_compile = on_compile
+
+        s = decode_slots
+        bp = self.prefill_batch
+        # decode=True + paged_pages selects the paged attention branch;
+        # inference never drops MoE tokens (models/sampling.py rationale)
+        dm = model.clone(decode=True, moe_no_drop=True,
+                         paged_pages=max_pages, page_size=page_size)
+        pick = _slot_picker(temperature, top_k, top_p)
+
+        def prefill_fn(p, cache, ids, prompt_lens, slot_map, slot_tables,
+                       tokens, positions, key):
+            """ids [Bp, Lp] zero-padded prompts; slot_map [Bp] target decode
+            slot (-1 = dummy padding row); slot_tables [Bp, pages_per_slot]
+            the target slots' block-table rows (all-trash for dummies).
+            Writes prompt K/V into the pool, picks each request's first
+            token (position = prompt_len, same fold convention as
+            gpt2_decode), and scatters token/position into the decode state
+            at the target slots (dummy rows drop)."""
+            pad = (jnp.arange(ids.shape[1])[None, :]
+                   < prompt_lens[:, None]).astype(jnp.int32)
+            logits, mvars = dm.apply({**p, "cache": cache}, ids, pad,
+                                     block_table=slot_tables,
+                                     mutable=["cache"])
+            last_idx = jnp.maximum(prompt_lens - 1, 0)
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1)[:, 0]   # [Bp, V]
+            # fold by target slot (dummies clamp to 0: picked then dropped)
+            first = pick(last, prompt_lens, jnp.maximum(slot_map, 0), key)
+            safe = jnp.where(slot_map >= 0, slot_map, s)  # s = out of bounds
+            tokens = tokens.at[safe].set(first.astype(tokens.dtype),
+                                         mode="drop")
+            positions = positions.at[safe].set(prompt_lens, mode="drop")
+            return mvars["cache"], tokens, positions
+
+        def decode_fn(p, cache, tokens, positions, block_table, active, key):
+            """``decode_span`` tokens for every slot: each inner step feeds
+            each slot's current token at its own position, writes its K/V
+            page entry, attends over its live prefix, and samples the next
+            token (folded at the position it will occupy). Inactive slots
+            write to trash and keep their state frozen. Returns the new
+            state plus the picked tokens — [S] at span 1, [span, S] above
+            (the scheduler's fetch attributes rows in order)."""
+
+            slot_ids = jnp.arange(s, dtype=jnp.int32)
+
+            def one(cache, tokens, positions):
+                logits, mvars = dm.apply({**p, "cache": cache},
+                                         tokens[:, None], None,
+                                         cache_index=positions,
+                                         block_table=block_table,
+                                         mutable=["cache"])
+                nxt_pos = positions + 1
+                nxt = pick(logits[:, 0], nxt_pos, slot_ids, key)
+                tokens = jnp.where(active > 0, nxt.astype(tokens.dtype),
+                                   tokens)
+                positions = jnp.where(active > 0, nxt_pos, positions)
+                return mvars["cache"], tokens, positions
+
+            if decode_span == 1:
+                cache, tokens, positions = one(cache, tokens, positions)
+                return cache, tokens, positions, tokens
+
+            def body(carry, _):
+                c, t, q = one(*carry)
+                return (c, t, q), t
+
+            (cache, tokens, positions), seq = jax.lax.scan(
+                body, (cache, tokens, positions), None, length=decode_span)
+            return cache, tokens, positions, seq
+
+        # Cache structure WITHOUT compiling an init variant: eval_shape the
+        # first-call (variable-creating) apply, then zero-fill. Every real
+        # prefill/decode then shares one with-cache signature.
+        ids0 = jax.ShapeDtypeStruct((bp, max_prompt_len), jnp.int32)
+        pad0 = jax.ShapeDtypeStruct((bp, max_prompt_len), jnp.int32)
+        bt0 = jax.ShapeDtypeStruct((bp, self.pages_per_slot), jnp.int32)
+        cache_abs = jax.eval_shape(
+            lambda p, i, m, bt: dm.apply(p, i, m, block_table=bt,
+                                         mutable=["cache"])[1]["cache"],
+            params, ids0, pad0, bt0)
+
+        okw_p: dict = {}
+        okw_d: dict = {}
+        if mesh is not None:
+            # Pinned output shardings: the functional state keeps ONE layout
+            # across every call, so the AOT executables can never meet a
+            # drifted input sharding (the step-2-recompile class the trainer
+            # kills the same way). Replicated state is the correctness-first
+            # baseline; a TP pages layout rides the flash-decode kernel
+            # later (ROADMAP item 4).
+            rep = NamedSharding(mesh, P())
+            cache_rep = jax.tree_util.tree_map(lambda _: rep, cache_abs)
+            okw_p["out_shardings"] = (cache_rep, rep, rep)
+            okw_d["out_shardings"] = (cache_rep, rep, rep, rep)
+        # pin_signature: every arg shape is fixed by construction (slots,
+        # prefill batch, table width are compiled-in), so the per-call
+        # signature walk over the params tree is pure overhead on the
+        # one-dispatch-per-token hot path
+        self._prefill_step = AOTStep(
+            jax.jit(prefill_fn, donate_argnums=(1,), **okw_p),
+            "serve_prefill", on_compile=self._note_compile,
+            pin_signature=True)
+        self._decode_step = AOTStep(
+            jax.jit(decode_fn, donate_argnums=(1,), **okw_d),
+            "serve_decode", on_compile=self._note_compile,
+            pin_signature=True)
+
+        # Device state (functional chain; cache is donated through it).
+        # Eager construction happens HERE, at wiring time — dispatches later
+        # run under the transfer guard, where only explicit puts are legal.
+        self.cache = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), cache_abs)
+        self.tokens = self._put(np.zeros((s,), np.int32))
+        self.positions = self._put(np.zeros((s,), np.int32))
+        self._block_table = self._put(
+            np.zeros((s, self.pages_per_slot), np.int32))
+        self._active = self._put(np.zeros((s,), np.int32))
+        key = rng if rng is not None else jax.random.PRNGKey(seed)
+        self._key = self._put_key(key)
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            self.cache = jax.device_put(self.cache,
+                                        jax.tree_util.tree_map(
+                                            lambda _: rep, cache_abs))
+
+    # ------------------------------------------------------------ plumbing
+
+    def _put(self, x: np.ndarray) -> jax.Array:
+        if self.mesh is not None:
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+        return jax.device_put(x)
+
+    def _put_key(self, key: jax.Array) -> jax.Array:
+        return (jax.device_put(key, NamedSharding(self.mesh, P()))
+                if self.mesh is not None else key)
+
+    def _ctx(self):
+        if self.mesh is None and not self._guard:
+            return contextlib.nullcontext()  # hot path: no ctx machinery
+        ctx = contextlib.ExitStack()
+        if self.mesh is not None:
+            ctx.enter_context(self.mesh)
+        if self._guard:
+            ctx.enter_context(jax.transfer_guard("disallow"))
+        return ctx
+
+    def _note_compile(self, name: str, seconds: float) -> None:
+        self.compile_time_s += seconds
+        if self._on_compile is not None:
+            self._on_compile(name, seconds)
+
+    def set_rng(self, key: jax.Array) -> None:
+        """Swap the sampling key (a dispatch ARGUMENT, so no recompile)."""
+        self._key = self._put_key(key)
+
+    def set_block_tables(self, table: np.ndarray) -> None:
+        """Refresh the device block-table mirror (admission/free changed
+        the host copy). Shape must stay [S, pages_per_slot]."""
+        self._block_table = self._put(np.ascontiguousarray(table, np.int32))
+
+    def set_active(self, active: np.ndarray) -> None:
+        self._active = self._put(np.ascontiguousarray(active, np.int32))
+
+    # ------------------------------------------------------------- phases
+
+    def prefill(self, ids: np.ndarray, prompt_lens: np.ndarray,
+                slot_map: np.ndarray, slot_tables: np.ndarray) -> jax.Array:
+        """Run the prefill executable for one admission batch. Returns the
+        post-merge tokens vector (a device handle — NOT donated, so the
+        scheduler's lagged fetch can read it later)."""
+        with self._ctx():
+            self.cache, self.tokens, self.positions = self._prefill_step(
+                self.params, self.cache,
+                self._put(np.ascontiguousarray(ids, np.int32)),
+                self._put(np.ascontiguousarray(prompt_lens, np.int32)),
+                self._put(np.ascontiguousarray(slot_map, np.int32)),
+                self._put(np.ascontiguousarray(slot_tables, np.int32)),
+                self.tokens, self.positions, self._key)
+        return self.tokens
+
+    def decode(self) -> jax.Array:
+        """Advance every slot by ``decode_span`` token(s) (dispatch only —
+        the host does not wait; fetches happen through the returned handle,
+        k dispatches behind). Returns the picked-token handle: [S] at
+        span 1, [span, S] above."""
+        with self._ctx():
+            (self.cache, self.tokens, self.positions,
+             toks) = self._decode_step(
+                self.params, self.cache, self.tokens, self.positions,
+                self._block_table, self._active, self._key)
+        return toks

@@ -1,0 +1,146 @@
+"""Paged KV cache: pure-XLA page ops + the host-side page allocator.
+
+The dense decode cache (models/backbone.py ``_cached_attention``) pins a
+full ``[B, H, max_len, Dh]`` buffer per layer for the whole batch — a slot
+serving a 20-token reply holds the same HBM as one at 4k context, and the
+worst-case batch must fit even when nothing runs that long. The serving
+answer (vLLM's PagedAttention; PAPERS: "Fine-Tuning and Serving Gemma 4 31B
+on Google Cloud TPU") is to store K/V in a shared pool of fixed-size PAGES
+indirected through a per-slot block table: slots consume pages as they
+grow, short requests free their pages on completion, and total residency is
+the pool size, not ``slots x max_len``.
+
+Device side (this module, pure jax — it is a leaf: no framework imports, so
+models/backbone.py can call into it without a cycle):
+
+* pages tensor per layer: ``[num_pages, page_size, H, Dh]`` for K and V;
+* :func:`write_prompt_kv` — scatter a prefill's [B, H, L, Dh] K/V rows into
+  the slots' pages (invalid/padded rows -> the trash page);
+* :func:`write_token_kv`  — scatter one decode step's [B, H, Dh] row at each
+  slot's own position;
+* :func:`gather_kv`       — gather a slot-major dense ``[B, H, Lmax, Dh]``
+  view for attention (the pure-XLA stand-in for a fused flash-decode
+  kernel, which slots in behind the same seam later — ROADMAP item 4).
+
+Everything is gather/scatter/``where`` — no host control flow — so the ops
+trace into the AOT-compiled prefill/decode executables and run on CPU for
+tier-1 tests. Page 0 is reserved as the TRASH page: every write that must
+not land anywhere (padded prompt tail, inactive slot, out-of-range
+position) is redirected there, and no read ever sees it (reads are masked
+to each slot's live prefix, which only spans pages the allocator assigned).
+
+Host side: :class:`PageManager` owns the free list and the block tables as
+plain numpy — allocation policy is host code (the scheduler reserves a
+request's worst-case pages at admission, so a mid-flight request can never
+strand), while the device only ever sees table CONTENTS as data.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TRASH_PAGE", "gather_kv", "write_prompt_kv", "write_token_kv",
+           "PageManager"]
+
+TRASH_PAGE = 0  # reserved: masked/invalid writes land here, reads never do
+
+
+def gather_kv(pages: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
+    """Dense per-slot view of the paged pool.
+
+    ``pages`` [P, page_size, H, Dh], ``block_table`` [B, n_pages] ->
+    [B, H, n_pages * page_size, Dh]. Entries beyond a slot's live length
+    are trash-page garbage; the caller masks them (backbone
+    ``_paged_attention``), and masked entries contribute exact zeros to the
+    softmax — at equal padded length the result is bit-identical to the
+    dense cache."""
+    g = pages[block_table]                        # [B, n, page_size, H, Dh]
+    b, n, ps, h, dh = g.shape
+    return g.reshape(b, n * ps, h, dh).transpose(0, 2, 1, 3)
+
+
+def write_prompt_kv(pages: jnp.ndarray, block_table: jnp.ndarray,
+                    kv: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Scatter a prefill's K (or V) rows into the slots' pages.
+
+    ``kv`` [B, H, L, Dh] holds positions 0..L-1 of each slot's prompt;
+    ``valid`` [B, L] (1 = real prompt token) routes padded tail positions
+    to the trash page instead. Returns the updated pages tensor."""
+    b, h, l, dh = kv.shape
+    ps = pages.shape[1]
+    pos = jnp.arange(l, dtype=jnp.int32)
+    page_idx = jnp.minimum(pos // ps, block_table.shape[1] - 1)
+    phys = block_table[:, page_idx]               # [B, L]
+    phys = jnp.where(valid > 0, phys, TRASH_PAGE)
+    rows = kv.transpose(0, 2, 1, 3).reshape(b * l, h, dh)
+    off = jnp.broadcast_to(pos % ps, (b, l)).reshape(-1)
+    return pages.at[phys.reshape(-1), off].set(rows)
+
+
+def write_token_kv(pages: jnp.ndarray, block_table: jnp.ndarray,
+                   kv: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """Scatter one decode step's K (or V) row at each slot's own position.
+
+    ``kv`` [B, H, Dh]; ``positions`` [B] is the index being written. Slots
+    whose block-table row is all trash (inactive/freed) write to the trash
+    page; positions past the table width clamp into the row, whose value is
+    then trash for exactly those slots."""
+    ps = pages.shape[1]
+    page_idx = jnp.minimum(positions // ps, block_table.shape[1] - 1)
+    phys = jnp.take_along_axis(block_table, page_idx[:, None], axis=1)[:, 0]
+    return pages.at[phys, positions % ps].set(kv)
+
+
+class PageManager:
+    """Host-side page allocator: free list + per-slot block tables.
+
+    Page ids are ints into the device pool; page 0 (TRASH_PAGE) is never
+    handed out. ``alloc`` is all-or-nothing (returns None when the pool
+    can't cover the request) so the scheduler's reserve-at-admission policy
+    stays atomic; ``free`` returns a slot's pages to the pool — the device
+    arrays involved are functional values, so freeing is pure bookkeeping
+    (an in-flight step that still reads those pages reads the array version
+    it was dispatched with)."""
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (1 is the reserved trash "
+                             f"page), got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # LIFO free list: recently-freed (still-warm) pages are reused first
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Max pages a single allocation can ever get (pool minus trash)."""
+        return self.num_pages - 1
+
+    def pages_for(self, length: int) -> int:
+        """Pages needed to hold ``length`` tokens (>= 1)."""
+        return max(1, -(-int(length) // self.page_size))
+
+    def alloc(self, n: int) -> Optional[np.ndarray]:
+        """``n`` page ids as int32, or None if the pool can't cover them."""
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._allocated.update(ids)
+        return np.asarray(ids, np.int32)
+
+    def free(self, ids: np.ndarray) -> None:
+        for i in map(int, np.asarray(ids).ravel()):
+            if i not in self._allocated:
+                raise ValueError(f"double free / foreign page id {i}")
+            self._allocated.discard(i)
+            self._free.append(i)

@@ -1,0 +1,401 @@
+"""DecodeServer: continuous batching over the prefill/decode engine.
+
+``run/sample.py`` (pre-serving) ran generation in LOCKSTEP batches: every
+prompt starts together, the batch ends when the longest generation ends,
+and a new request waits for the whole batch to drain. A serving loop keeps
+the compiled decode batch FULL instead: every step, queued requests are
+admitted into whatever slots are free (prefill batched opportunistically),
+decode always runs at the compiled slot count with an active mask, and a
+finished request frees its slot and pages immediately for the next one.
+
+Host/device split (the async-dispatch pattern from the trainer's lagged
+metrics, PR 5): the host dispatches decode step N, then fetches step N-1's
+token vector — blocking on N-1 while N executes, so scheduler bookkeeping
+(admission, page accounting, output assembly) overlaps device time instead
+of serializing behind it. Completion is COUNT-based (each request's
+generation budget is known at admission), so the host never has to sync on
+content to schedule; an optional EOS id finishes a request early, observed
+one lagged step late by construction.
+
+Invariants the tests pin (tests/test_serving.py):
+
+* no slot or page leaks — after drain, every slot is free and the page
+  pool is back to full;
+* bounded completion — pages for a request's WORST CASE (prompt + budget)
+  are reserved at admission, so an admitted request can always run to
+  completion without preempting anyone;
+* late arrivals preempt nothing — an admission only ever touches free
+  slots/pages, so in-flight requests' outputs are unchanged (greedy
+  decode: token-for-token).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Deque, List, Optional
+
+import jax
+import numpy as np
+
+from ..utils.perf import EventStats, RecompileMonitor
+from .engine import DecodeEngine
+from .paged_kv import TRASH_PAGE, PageManager
+
+__all__ = ["Request", "DecodeServer", "one_shot_decode"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle bookkeeping."""
+
+    id: int
+    prompt: np.ndarray              # int32 [prompt_len]
+    max_new_tokens: int
+    g_max: int = 0                  # tokens this request WILL generate
+    # (min(max_new_tokens, max_len - prompt_len), fixed at submit — the
+    # single cap admission, release, and fetch-truncation all share)
+    eos_id: Optional[int] = None
+    submit_t: float = 0.0
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None  # submit -> first token FETCHED
+    finished: bool = False          # output collection complete
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+
+@dataclasses.dataclass
+class _SlotState:
+    """Host mirror of one decode slot (no device fetch needed to
+    schedule): dispatch-side generation count and position."""
+
+    req: Request
+    pages: np.ndarray               # page ids reserved for this request
+    generated: int = 1              # prefill produced token #1
+    position: int = 0               # index of the token currently in state
+
+
+class DecodeServer:
+    """Continuous-batching decode service over a :class:`DecodeEngine`.
+
+    ``submit()`` enqueues requests; ``step()`` advances the world by one
+    decode step (admitting first, fetching last); ``drain()`` runs until
+    everything submitted has completed. ``sanitize=True`` mirrors the
+    trainer's runtime sanitizer: every XLA compile counts into
+    ``recompile_count`` (steady state must freeze it — the two phase
+    executables compile exactly once) and dispatches run under
+    ``jax.transfer_guard("disallow")``.
+    """
+
+    def __init__(self, workload, params, *, decode_slots: int = 8,
+                 page_size: int = 16, max_pages: int = 0,
+                 max_prompt_len: int = 0, max_len: int = 0,
+                 prefill_batch: int = 0, decode_span: int = 1,
+                 temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                 rng: Optional[jax.Array] = None, eos_id: Optional[int] = None,
+                 mesh=None, sanitize: bool = False,
+                 dispatch_lag: int = 1) -> None:
+        max_len = max_len or workload.seq_len
+        max_prompt_len = max_prompt_len or max(2, max_len // 2)
+        pages_per_slot = -(-max_len // page_size)
+        if max_pages <= 0:
+            # full residency default: every slot can hold max_len — the
+            # pool-smaller-than-worst-case regime is opt-in via max_pages
+            max_pages = 1 + decode_slots * pages_per_slot
+        self.sanitize = sanitize
+        self._recompiles = RecompileMonitor()
+        if sanitize:
+            self._recompiles.install()
+        try:
+            self.engine = DecodeEngine(
+                workload, params, decode_slots=decode_slots,
+                page_size=page_size, max_pages=max_pages,
+                max_prompt_len=max_prompt_len, max_len=max_len,
+                prefill_batch=prefill_batch, decode_span=decode_span,
+                temperature=temperature,
+                top_k=top_k, top_p=top_p, rng=rng, seed=seed, mesh=mesh,
+                transfer_guard=sanitize)
+        except BaseException:
+            self._recompiles.uninstall()  # failed build must not leak the
+            raise                         # process-global 'jax' log handler
+        self.mgr = PageManager(max_pages, page_size)
+        s = decode_slots
+        self.block_tables = np.zeros((s, self.engine.pages_per_slot),
+                                     np.int32)  # all TRASH_PAGE
+        self.active = np.zeros((s,), np.int32)
+        self.slots: List[Optional[_SlotState]] = [None] * s
+        self.queue: Deque[Request] = collections.deque()
+        self.default_eos_id = eos_id
+        self.dispatch_lag = max(0, dispatch_lag)
+        # lagged fetch ring: (device tokens handle, [(slot, Request)] whose
+        # token in that vector is NEW)
+        self._ring: Deque[Any] = collections.deque()
+        self._dirty = False     # block tables / active changed since put
+        self._needs_sweep = False  # a fetch EOS-finished a request whose
+        # slot is still held (count-based completions release inline)
+        self._req_counter = 0
+        self.ttft = EventStats()
+        self.decode_steps = 0
+        self.prefill_steps = 0
+        self.tokens_fetched = 0
+
+    # ----------------------------------------------------------- gauges etc.
+
+    @property
+    def compile_time_s(self) -> float:
+        return self.engine.compile_time_s
+
+    @property
+    def recompile_count(self) -> int:
+        return self._recompiles.count
+
+    def stop_sanitizer(self) -> int:
+        """Detach the process-global sanitizer hooks; returns the final
+        compile count. Idempotent; no-op when sanitize was off."""
+        self._recompiles.uninstall()
+        return self._recompiles.count
+
+    @property
+    def free_slots(self) -> int:
+        return sum(1 for s in self.slots if s is None)
+
+    @property
+    def busy(self) -> bool:
+        """Anything queued, in flight, or awaiting fetch."""
+        return bool(self.queue or any(s is not None for s in self.slots)
+                    or self._ring)
+
+    @property
+    def time_to_first_token_s(self) -> float:
+        """Mean submit->first-token latency over completed TTFTs."""
+        return self.ttft.summary()["mean"]
+
+    def reset_stats(self) -> None:
+        """Zero the serving gauges (bench: warmup vs timed window)."""
+        self.ttft = EventStats()
+        self.decode_steps = 0
+        self.prefill_steps = 0
+        self.tokens_fetched = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def set_rng(self, key: jax.Array) -> None:
+        self.engine.set_rng(key)
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+               eos_id: Optional[int] = None) -> Request:
+        prompt = np.ascontiguousarray(prompt, np.int32).ravel()
+        if not 1 <= prompt.shape[0] <= self.engine.max_prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.shape[0]} outside [1, "
+                f"max_prompt_len={self.engine.max_prompt_len}]")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        g_max = min(max_new_tokens,
+                    self.engine.max_len - int(prompt.shape[0]))
+        if g_max < 1:
+            raise ValueError(
+                f"prompt of {prompt.shape[0]} tokens leaves no room to "
+                f"generate under max_len={self.engine.max_len}")
+        total = prompt.shape[0] + g_max
+        if self.mgr.pages_for(total) > self.mgr.capacity:
+            raise ValueError(
+                f"request needs {self.mgr.pages_for(total)} pages but the "
+                f"pool holds {self.mgr.capacity}; raise max_pages or lower "
+                f"max_new_tokens")
+        self._req_counter += 1
+        req = Request(id=self._req_counter, prompt=prompt,
+                      max_new_tokens=max_new_tokens, g_max=g_max,
+                      eos_id=self.default_eos_id if eos_id is None else eos_id,
+                      submit_t=time.perf_counter())
+        self.queue.append(req)
+        return req
+
+    def _release(self, slot: int) -> None:
+        st = self.slots[slot]
+        if st is None:
+            return
+        self.mgr.free(st.pages)
+        self.block_tables[slot, :] = TRASH_PAGE
+        self.active[slot] = 0
+        self.slots[slot] = None
+        self._dirty = True
+
+    def _admit(self) -> bool:
+        """Admit queued requests into free slots, up to one prefill batch.
+        All-or-nothing page reservation per request (worst case: prompt +
+        budget), head-of-line: a request that doesn't fit WAITS — it never
+        preempts pages or slots from in-flight requests."""
+        if not self.queue:
+            return False  # hot path: nothing to admit, skip the slot scan
+        free = [s for s in range(len(self.slots)) if self.slots[s] is None]
+        batch: List[tuple] = []
+        while (self.queue and free
+               and len(batch) < self.engine.prefill_batch):
+            req = self.queue[0]
+            total = req.prompt_len + req.g_max
+            pages = self.mgr.alloc(self.mgr.pages_for(total))
+            if pages is None:
+                break  # pool exhausted: wait for completions to free pages
+            slot = free.pop(0)
+            self.queue.popleft()
+            self.block_tables[slot, :] = TRASH_PAGE
+            self.block_tables[slot, :len(pages)] = pages
+            self.active[slot] = 1
+            self.slots[slot] = _SlotState(req=req, pages=pages,
+                                          position=req.prompt_len)
+            self._dirty = True
+            batch.append((slot, req))
+        if not batch:
+            return False
+        bp, lp = self.engine.prefill_batch, self.engine.max_prompt_len
+        ids = np.zeros((bp, lp), np.int32)
+        lens = np.zeros((bp,), np.int32)
+        smap = np.full((bp,), -1, np.int32)
+        stables = np.zeros((bp, self.engine.pages_per_slot), np.int32)
+        for i, (slot, req) in enumerate(batch):
+            ids[i, :req.prompt_len] = req.prompt
+            lens[i] = req.prompt_len
+            smap[i] = slot
+            stables[i] = self.block_tables[slot]
+        toks = self.engine.prefill(ids, lens, smap, stables)
+        self.prefill_steps += 1
+        self._ring.append((toks, list(batch)))
+        # a budget-1 request is already complete at dispatch level
+        for slot, _ in batch:
+            st = self.slots[slot]
+            if st is not None and st.generated >= st.req.g_max:
+                self._release(slot)
+        return True
+
+    def step(self) -> bool:
+        """One scheduler tick: sweep EOS completions -> admit -> dispatch
+        decode -> lagged fetch. Returns False when nothing advanced (idle:
+        no queue, no active slots, no pending fetches)."""
+        # EOS sweep: requests finished by content (observed at fetch, one
+        # step late) release their slot before new work is admitted. Only
+        # when a fetch actually flagged one — count-based completions
+        # release inline at dispatch time.
+        if self._needs_sweep:
+            for slot, st in enumerate(self.slots):
+                if st is not None and st.req.finished:
+                    self._release(slot)
+            self._needs_sweep = False
+        # admit until the queue, the free slots, or the page pool runs out
+        # (several prefill batches per tick when a burst arrives): decode
+        # windows then run at full occupancy instead of ramping one
+        # prefill batch per window
+        dispatched = False
+        while self._admit():
+            dispatched = True
+        if self.active.any():
+            if self._dirty:
+                self.engine.set_block_tables(self.block_tables)
+                self.engine.set_active(self.active)
+                self._dirty = False
+            snap = [(s, st.req) for s, st in enumerate(self.slots)
+                    if st is not None and self.active[s]]
+            toks = self.engine.decode()
+            span = self.engine.decode_span
+            self.decode_steps += 1
+            self._ring.append((toks, snap))
+            for s, _ in snap:
+                st = self.slots[s]
+                # mirrors advance by the full span (the device does,
+                # unconditionally, while the slot is active); a budget hit
+                # mid-span overshoots harmlessly — see DecodeEngine
+                st.generated += span
+                st.position += span
+                if st.generated >= st.req.g_max:  # budget spent:
+                    self._release(s)          # completion, no fetch needed
+            dispatched = True
+        # Lagged on busy ticks (the overlap); full drain on idle ticks —
+        # with nothing left to dispatch there is no step to hide the
+        # fetch behind, and drain() must be able to terminate.
+        self._fetch(self.dispatch_lag if dispatched else 0)
+        return dispatched or bool(self._ring)
+
+    def _fetch(self, lag: int) -> None:
+        """Drain the fetch ring down to ``lag`` entries, attributing each
+        fetched token vector to its snapshot's requests. The device_get here
+        is the only host<->device sync in the loop — and it blocks on step
+        N-lag while step N executes (the PR 5 overlap)."""
+        while len(self._ring) > lag:
+            toks_dev, snap = self._ring.popleft()
+            arr = np.asarray(jax.device_get(toks_dev))
+            rows = arr if arr.ndim == 2 else arr[None]  # [span|1, S]
+            now = time.perf_counter()
+            for slot, req in snap:
+                if req.finished:
+                    continue
+                for row in rows:
+                    tok = int(row[slot])
+                    req.tokens.append(tok)
+                    self.tokens_fetched += 1
+                    if req.ttft_s is None:
+                        req.ttft_s = now - req.submit_t
+                        self.ttft.add(req.ttft_s)
+                    if req.eos_id is not None and tok == req.eos_id:
+                        req.finished = True
+                        self._needs_sweep = True  # slot may still be held
+                    elif len(req.tokens) >= req.g_max:
+                        req.finished = True  # overshoot rows are discarded
+                    if req.finished:
+                        break
+
+    def drain(self) -> None:
+        """Run until every submitted request has completed and every token
+        has been fetched. Bounded by construction: admitted requests hold
+        reserved pages, so each completes in ``g_max`` steps, freeing
+        capacity for the queue."""
+        while self.busy:
+            if not self.step():
+                break
+        self._fetch(0)
+
+
+def one_shot_decode(workload, params, ids: np.ndarray, prompt_len: int, *,
+                    temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 0.0, rng: Optional[jax.Array] = None,
+                    seed: int = 0, page_size: int = 0, mesh=None,
+                    server: Optional[DecodeServer] = None) -> np.ndarray:
+    """Batch continuation through the SERVING path: the same prefill/decode
+    executables that serve traffic, driven as one lockstep batch — one code
+    path for one-shot (run/sample.py) and served decode.
+
+    ``ids`` int [B, L]: positions < ``prompt_len`` are the prompts; the
+    suffix is regenerated out to L. Greedy output is token-for-token
+    identical to ``models.sampling.gpt2_decode`` (tested); stochastic
+    decoding folds the key per slot position (the serving convention).
+    Pass ``server`` to reuse compiled executables across calls (the
+    engine's state fully recycles between drained batches); by default one
+    is built with a single page per slot (``page_size = L``)."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    b, l = ids.shape
+    if not 1 <= prompt_len < l:
+        if prompt_len == l:
+            return ids.copy()
+        raise ValueError(f"prompt_len {prompt_len} outside [1, {l}]")
+    if server is None:
+        # max_prompt_len = L: the prefill runs at the same padded length as
+        # gpt2_decode's full-ids prefill, so the masked-softmax reductions
+        # have identical shapes and greedy outputs match token for token
+        server = DecodeServer(
+            workload, params, decode_slots=b, page_size=page_size or l,
+            max_prompt_len=l, max_len=l, prefill_batch=b,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            rng=rng, seed=seed, mesh=mesh)
+    elif rng is not None:
+        server.set_rng(rng)
+    reqs = [server.submit(ids[i, :prompt_len],
+                          max_new_tokens=l - prompt_len) for i in range(b)]
+    server.drain()
+    out = ids.copy()
+    for i, req in enumerate(reqs):
+        gen = np.asarray(req.tokens, np.int32)
+        out[i, prompt_len:prompt_len + len(gen)] = gen
+    return out

@@ -19,7 +19,7 @@ import jax
 __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
            "StepTimer", "mfu", "enable_persistent_compilation_cache",
            "timed_lower_compile", "AOTStep", "RecompileMonitor",
-           "StallBreakdown"]
+           "StallBreakdown", "EventStats"]
 
 # Peak dense bf16 FLOP/s per chip (public spec sheets), matched IN ORDER
 # against jax's device_kind strings — real hardware reports e.g.
@@ -137,15 +137,24 @@ class AOTStep:
     reports the duration to ``on_compile(name, seconds)``; subsequent calls
     dispatch straight to the compiled executable. Shape changes fall back to
     a fresh compile rather than erroring, so callers keep jit's flexibility
-    while gaining the timing split."""
+    while gaining the timing split.
+
+    ``pin_signature=True`` skips the per-call signature walk once compiled:
+    for a large pytree argument (a params tree) the tree_map costs real
+    host time on a hot sub-millisecond path (serving decode dispatches one
+    step per generated token). Only for callers whose arg shapes are
+    invariant by construction — a drifted shape then surfaces as the AOT
+    executable's own mismatch error instead of a silent recompile."""
 
     def __init__(self, jitted: Any, name: str = "step",
-                 on_compile: Optional[Callable[[str, float], None]] = None):
+                 on_compile: Optional[Callable[[str, float], None]] = None,
+                 pin_signature: bool = False):
         self._jitted = jitted
         self.name = name
         self._on_compile = on_compile
         self._compiled: Any = None
         self._sig: Any = None
+        self._pin = pin_signature
         self.compile_time_s = 0.0
 
     @staticmethod
@@ -155,6 +164,8 @@ class AOTStep:
             args)
 
     def __call__(self, *args: Any) -> Any:
+        if self._pin and self._compiled is not None:
+            return self._compiled(*args)
         sig = self._signature(args)
         if self._compiled is None or sig != self._sig:
             self._compiled, dt = timed_lower_compile(self._jitted, *args)
@@ -267,6 +278,38 @@ class StallBreakdown:
     def totals(self) -> dict:
         """Cumulative per-step means since construction."""
         return self._means(self._tot)
+
+
+class EventStats:
+    """Per-event latency accounting (e.g. serving time-to-first-token):
+    throughput means hide tail latency, and serving SLOs live in the tail.
+
+    ``add`` records one event's seconds; ``summary`` reports count, mean,
+    p50, p95 (nearest-rank on the sorted sample), and max — all 0.0 when
+    empty so downstream rows always carry every key."""
+
+    def __init__(self) -> None:
+        self._vals: list = []
+
+    def add(self, seconds: float) -> None:
+        self._vals.append(float(seconds))
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    def summary(self) -> dict:
+        if not self._vals:
+            return {"count": 0, "mean": 0.0, "p50": 0.0, "p95": 0.0,
+                    "max": 0.0}
+        v = sorted(self._vals)
+        n = len(v)
+        return {
+            "count": n,
+            "mean": sum(v) / n,
+            "p50": v[(n - 1) // 2],
+            "p95": v[min(n - 1, max(0, -(-95 * n // 100) - 1))],
+            "max": v[-1],
+        }
 
 
 class StepTimer:

@@ -22,6 +22,10 @@ GATED_PATHS = [
     # the steady-state-throughput tests drive the trainer's outer loop
     # directly — exactly where GL007 (host-sync-in-loop) hazards breed
     os.path.join(ROOT, "tests", "test_device_prefetch.py"),
+    # the serving tests drive the decode scheduler's host loop — the same
+    # per-step host-sync breeding ground (the serving/ package itself is
+    # inside the distributed_pipeline_tpu walk above)
+    os.path.join(ROOT, "tests", "test_serving.py"),
 ]
 
 

@@ -63,6 +63,20 @@ def test_loss_decreases_over_steps(tmp_path):
     assert loop.step == 31
 
 
+def test_loss_decreases_with_prefetch_and_lagged_dispatch(tmp_path):
+    """The non-eager TrainLoop shipped as the CONFIG default (PR 5:
+    prefetch_depth=2, dispatch_lag=1) must train like the eager path —
+    tier-1 exercises the real-run configuration, not just the wrapper's
+    own unit tests (test_device_prefetch.py)."""
+    loop = make_loop(tmp_path, prefetch_depth=2, dispatch_lag=1)
+    first = float(loop.run_step(next(loop.data))["loss"])  # DeviceBatch path
+    for _ in range(30):
+        m = loop.run_step(next(loop.data))
+    loop.flush_metrics()  # drain the lagged ring like run_loop's boundaries
+    assert float(m["loss"]) < first
+    assert loop.step == 31
+
+
 def test_grad_accumulation_equivalence(tmp_path):
     """microbatch=B vs microbatch=B/4 must produce identical updates for an
     rng-independent loss (the reference's no_sync accumulation semantics)."""
