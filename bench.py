@@ -437,6 +437,129 @@ def main() -> None:
             "recompile_count": steady_recompiles,
         }
 
+    def measure_chaos(name: str, *, steps: int, save_interval: int,
+                      kill_step: int, crash_save_step: int,
+                      batch: int = 8, hidden: int = 64, layers: int = 2,
+                      max_restarts: int = 4, backoff_s: float = 0.2):
+        """Robustness leg (ISSUE 8): a SUPERVISED spawned training ring
+        with two injected kills — one mid-step (SIGKILL at ``kill_step``),
+        one mid-checkpoint-save (SIGKILL between array write and finalize
+        at ``crash_save_step``) — must complete to the target step through
+        the launcher's restart/backoff machinery and checkpoint
+        auto-resume, and the run's GOODPUT (useful-step time / wall time,
+        chaos.goodput.aggregate_run over attempts.jsonl + the per-attempt
+        records) is the leg's headline number. Uses the CPU smoke shape
+        regardless of backend: the leg measures the recovery stack, not
+        the chip (and this image's jax cannot run cross-process CPU
+        collectives, so the ring is one supervised worker — the restart
+        path is identical). ``recompile_count`` reports the max
+        STEADY-state compile count over resumed attempts: with the
+        persistent compile cache warm, a resumed attempt must not
+        recompile after its first step."""
+        import shutil
+        import subprocess
+
+        from distributed_pipeline_tpu.chaos import (aggregate_run,
+                                                    read_goodput_records)
+
+        run_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", "chaos_run"))
+        shutil.rmtree(run_dir, ignore_errors=True)
+        plan = {"faults": [
+            {"kind": "kill", "step": kill_step, "rank": 0,
+             "sig": "SIGKILL"},
+            {"kind": "crash_in_save", "step": crash_save_step, "rank": 0},
+        ]}
+        env = dict(os.environ)
+        env.update({"DPT_CHAOS_PLAN": json.dumps(plan),
+                    "JAX_PLATFORMS": "cpu"})
+        # the ring workers size their own fake-device count
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        cmd = [sys.executable, "-m", "distributed_pipeline_tpu.run.train",
+               "--distributed", "--nprocs", "1",
+               "--max_restarts", str(max_restarts),
+               "--restart_backoff_s", str(backoff_s),
+               "--batch_size", str(batch), "--microbatch", str(batch // 2),
+               "--seq_len", "64", "--vocab_size", "64",
+               "--hidden_size", str(hidden), "--num_layers", str(layers),
+               "--num_heads", "2", "--diffusion_steps", "50",
+               "--dtype", "float32", "--ema_rate", "0.9",
+               "--learning_steps", str(steps),
+               "--save_interval", str(save_interval),
+               "--eval_interval", "1000000", "--log_interval", "1000000",
+               "--sanitize", "true",
+               # the bench's persistent compile cache, shared across
+               # attempts AND bench rounds: resumed attempts (and repeat
+               # runs) pay a cache lookup, not an XLA compile — the
+               # recompile_count==0 acceptance rides on it ('auto' would
+               # also warm attempts 1+, via the run dir, just not rounds)
+               "--compilation_cache_dir", cache_dir or "auto",
+               "--checkpoint_path", run_dir]
+        t0 = time.perf_counter()
+        # Own timeout UNDER the leg's SIGALRM cap, and the ring runs in
+        # its OWN SESSION so expiry can killpg the whole tree — killing
+        # only the launcher would orphan the worker it spawned, leaving
+        # it to burn the box and hold the run dir for later rounds.
+        ring = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            ring_out, ring_err = ring.communicate(timeout=230)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(ring.pid, signal.SIGKILL)
+            except OSError:
+                pass  # the group died between expiry and the kill
+            ring.wait()
+            return {"name": name,
+                    "error": "chaos ring exceeded its 230s timeout"}
+        wall = time.perf_counter() - t0
+        agg = aggregate_run(run_dir)
+        completed = os.path.isdir(
+            os.path.join(run_dir, f"model_{steps:06d}"))
+        # max steady-state recompile count over RESUMED attempts, from the
+        # clean-exit sidecars (preferred) or the post-mortem beacon
+        # snapshots in attempts.jsonl
+        sidecars = read_goodput_records(run_dir)
+        resumed_recompiles = 0
+        for rec in agg["per_attempt"]:
+            a = int(rec.get("attempt", 0))
+            if a == 0:
+                continue
+            src = sidecars.get(a) or rec
+            c = src.get("steady_recompile_count")
+            if c is not None:
+                resumed_recompiles = max(resumed_recompiles, int(c))
+        if not completed:
+            tail = (ring_err or ring_out or "")[-300:]
+            return {"name": name,
+                    "error": f"chaos run did not reach step {steps} "
+                             f"(rc={ring.returncode}): {tail}"}
+        return {
+            "name": name,
+            "completed": True,
+            "goodput": round(agg["goodput"], 4),
+            "useful_step_s": round(agg["useful_step_s"], 2),
+            "startup_s": round(agg["startup_s"], 2),
+            "setup_s": round(agg["setup_s"], 2),
+            "restore_s": round(agg["restore_s"], 2),
+            "compile_s": round(agg["compile_s"], 2),
+            "save_s": round(agg["save_s"], 2),
+            "data_stall_s": round(agg["data_stall_s"], 2),
+            "recompute_s": round(agg["recompute_s"], 2),
+            "lost_s": round(agg["lost_s"], 2),
+            "downtime_s": round(agg["downtime_s"], 2),
+            "wall_s": round(agg["wall_s"], 2),
+            "accounted_frac": round(agg["accounted_frac"], 4),
+            "attempts": agg["attempts"],
+            "injected_faults": len(plan["faults"]),
+            "recompile_count": resumed_recompiles,
+            "steps": steps, "batch": batch,
+            "leg_wall_s": round(wall, 1),
+        }
+
     def measure_prefetch_ab(name: str, *, family: str, size: str,
                             seq_len: int, batch: int, microbatch: int = 0,
                             window_steps: int = 4, rounds: int = 6,
@@ -654,6 +777,21 @@ def main() -> None:
             measure_decode, "gpt2-base-decode-oneshot-b1",
             gen_tokens=128 if on_tpu else 24,
             batch=1, seq_len=1024 if on_tpu else 64)),
+        # Chaos/goodput leg (ISSUE 8): headline-named because it proves
+        # the headline WORKFLOW (elastic launcher + auto-resume + warm
+        # compile cache) survives two injected kills — one mid-step, one
+        # mid-checkpoint-save — with goodput >= 0.7 and zero steady-state
+        # recompiles on resumed attempts. Always the CPU smoke shape: the
+        # leg measures the recovery stack, not the chip. Step counts are
+        # sized so useful step time dominates the ~3 attempts' fixed
+        # startup+compile overhead on this box.
+        # kill_step is deliberately OFF the save cadence: the 100 steps
+        # since the last checkpoint are lost and re-run after resume —
+        # the recompute_s share of the breakdown.
+        ("diffuseq-base-seq128-chaos", functools.partial(
+            measure_chaos, "diffuseq-base-seq128-chaos",
+            steps=4000, save_interval=250, batch=16,
+            kill_step=1600, crash_save_step=2750)),
         # no-accumulation variant (pure config-2 semantics)
         ("diffuseq-base-seq128-noaccum", functools.partial(
             measure, "diffuseq-base-seq128-noaccum", family="diffuseq",

@@ -1,0 +1,35 @@
+"""Fault injection + goodput accounting for the elastic training stack.
+
+The recovery machinery — the self-relaunching launcher, checkpoint
+auto-resume, the persistent compile cache — is only as real as the failures
+it has survived. This package supplies the failures (:class:`ChaosPlan` /
+:class:`ChaosInjector`: scheduled kills, crashes mid-checkpoint-save, data
+stalls, corrupted checkpoints) and the metric that proves survival was
+cheap (:mod:`.goodput`: useful-step time / wall time, with every second of
+a restarted run attributed to a category).
+
+Import-light by design: the launcher imports this before jax exists in the
+process.
+"""
+
+from .goodput import (
+    aggregate_run,
+    append_attempt,
+    attempts_path,
+    beacon_max_step,
+    beacon_path,
+    goodput_record_path,
+    read_attempts,
+    read_beacons,
+    read_goodput_records,
+)
+from .inject import ChaosInjector, corrupt_newest_checkpoint
+from .plan import CHAOS_PLAN_ENV, ChaosFault, ChaosPlan
+
+__all__ = [
+    "ChaosFault", "ChaosPlan", "ChaosInjector", "CHAOS_PLAN_ENV",
+    "corrupt_newest_checkpoint",
+    "aggregate_run", "append_attempt", "attempts_path", "beacon_max_step",
+    "beacon_path", "goodput_record_path", "read_attempts", "read_beacons",
+    "read_goodput_records",
+]

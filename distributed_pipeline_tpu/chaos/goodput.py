@@ -1,0 +1,176 @@
+"""Run-level goodput aggregation: every second of a (possibly much-
+restarted) run accounted for.
+
+Three artifact kinds live in the run dir, written by different parties:
+
+* ``.progress_rank{k}.json`` — per-rank BEACON, overwritten every
+  optimizer step by the trainer: current step, wall-clock, and the
+  in-attempt :class:`~..utils.perf.GoodputTracker` summary so far. A
+  SIGKILLed attempt's last beacon is its flight recorder.
+* ``goodput_attempt{A:03d}.json`` — rank 0's final goodput record for a
+  CLEANLY exited attempt (written at ``run_loop`` exit).
+* ``attempts.jsonl`` — the LAUNCHER's structured per-attempt log:
+  attempt index, exit code, spawn/exit wall-clock, step progress
+  (from the beacons), downtime before the attempt, resume overhead, and
+  a post-mortem snapshot of rank 0's beacon.
+
+:func:`aggregate_run` folds all three into one decomposition::
+
+    wall ≈ useful + startup + restore + compile + save + data_stall
+           + recompute + lost + downtime
+
+with ``goodput = useful / wall`` — the bench's acceptance metric.
+
+Import-light (no jax): the launcher reads and writes these artifacts
+before/after worker processes exist.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "beacon_path", "read_beacons", "beacon_max_step",
+    "attempts_path", "append_attempt", "read_attempts",
+    "goodput_record_path", "read_goodput_records", "aggregate_run",
+]
+
+_BEACON_RE = re.compile(r"\.progress_rank(\d+)\.json$")
+
+# Goodput categories summed across attempts (mirrors
+# perf.GoodputTracker.CATEGORIES + the data_stall merged at summary time).
+_CATEGORIES = ("startup_s", "setup_s", "restore_s", "compile_s", "save_s",
+               "data_stall_s", "recompute_s")
+
+
+def beacon_path(run_dir: str, rank: int) -> str:
+    return os.path.join(run_dir, f".progress_rank{rank}.json")
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # torn mid-replace read / dead file: skip
+
+
+def read_beacons(run_dir: str) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for path in glob.glob(os.path.join(run_dir, ".progress_rank*.json")):
+        m = _BEACON_RE.search(path)
+        payload = _read_json(path) if m else None
+        if m and isinstance(payload, dict):
+            out[int(m.group(1))] = payload
+    return out
+
+
+def beacon_max_step(run_dir: str) -> int:
+    """Highest step ANY rank's beacon ever reported — the resume boundary
+    for recompute accounting (steps at or below it were already paid for
+    by an earlier attempt)."""
+    return max((int(b.get("step", 0)) for b in read_beacons(run_dir).values()),
+               default=0)
+
+
+def attempts_path(run_dir: str) -> str:
+    return os.path.join(run_dir, "attempts.jsonl")
+
+
+def append_attempt(run_dir: str, record: dict) -> None:
+    with open(attempts_path(run_dir), "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def read_attempts(run_dir: str) -> List[dict]:
+    path = attempts_path(run_dir)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass  # torn tail line from a killed writer
+    return out
+
+
+def goodput_record_path(run_dir: str, attempt: int) -> str:
+    return os.path.join(run_dir, f"goodput_attempt{attempt:03d}.json")
+
+
+def read_goodput_records(run_dir: str) -> Dict[int, dict]:
+    out: Dict[int, dict] = {}
+    for path in glob.glob(os.path.join(run_dir, "goodput_attempt*.json")):
+        payload = _read_json(path)
+        if isinstance(payload, dict):
+            out[int(payload.get("attempt", 0))] = payload
+    return out
+
+
+def aggregate_run(run_dir: str) -> Dict[str, Any]:
+    """Fold a run's attempts into one goodput decomposition.
+
+    Per attempt, the in-attempt record is the clean-exit sidecar when one
+    exists, else the launcher's post-mortem beacon snapshot (a killed
+    attempt's flight recorder). Attempt wall not covered by either —
+    including whole attempts that died before their first beacon — lands
+    in ``lost_s``: genuinely thrown-away time. ``downtime_s`` is the
+    launcher-observed gap between attempts (teardown + backoff + spawn).
+    """
+    attempts = read_attempts(run_dir)
+    sidecars = read_goodput_records(run_dir)
+    cats = {c: 0.0 for c in _CATEGORIES}
+    useful = lost = downtime = 0.0
+    per_attempt: List[dict] = []
+
+    def _fold(idx: int, duration_s: Optional[float], gp: Optional[dict]):
+        nonlocal useful, lost
+        if gp:
+            for c in _CATEGORIES:
+                cats[c] += float(gp.get(c, 0.0))
+            useful += float(gp.get("useful_step_s", 0.0))
+            if duration_s is not None:
+                lost += max(0.0, duration_s - float(gp.get("wall_s", 0.0)))
+        elif duration_s is not None:
+            lost += duration_s
+
+    if attempts:
+        for rec in attempts:
+            idx = int(rec.get("attempt", 0))
+            gp = sidecars.get(idx) or rec.get("goodput") or None
+            downtime += float(rec.get("downtime_s", 0.0))
+            _fold(idx, float(rec.get("duration_s", 0.0)), gp)
+            per_attempt.append({**rec,
+                                "goodput_source": ("sidecar" if idx in sidecars
+                                                   else "beacon" if gp
+                                                   else None)})
+        wall = (float(attempts[-1].get("t_exit", 0.0))
+                - float(attempts[0].get("t_spawn", 0.0)))
+    else:
+        # Launcher-less run (single process): the sidecars are all there is.
+        for idx in sorted(sidecars):
+            _fold(idx, None, sidecars[idx])
+            per_attempt.append({"attempt": idx, "goodput_source": "sidecar"})
+        wall = sum(float(s.get("wall_s", 0.0)) for s in sidecars.values())
+    wall = max(wall, 1e-9)
+    accounted = useful + sum(cats.values()) + lost + downtime
+    return {
+        "wall_s": wall,
+        "useful_step_s": useful,
+        "goodput": useful / wall,
+        **cats,
+        "lost_s": lost,
+        "downtime_s": downtime,
+        "accounted_s": accounted,
+        "accounted_frac": accounted / wall,
+        "attempts": len(per_attempt),
+        "per_attempt": per_attempt,
+    }

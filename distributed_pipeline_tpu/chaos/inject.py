@@ -1,0 +1,170 @@
+"""ChaosInjector: executes a :class:`~.plan.ChaosPlan` inside a live run.
+
+The trainer calls three tiny hooks (``on_step`` at the top of every
+optimizer step, ``on_data`` before pulling a batch, ``on_save`` right after
+a checkpoint save is scheduled); each hook fires whatever faults the plan
+schedules for the current step on this rank. Every fault fires AT MOST ONCE
+PER RUN: a marker file in the run dir (written BEFORE the fault executes)
+makes the respawned attempt sail past the step that killed its predecessor
+— the same marker idiom the launcher restart tests pioneered, now owned by
+the injector so every fault kind gets it for free.
+
+Import-light on purpose: the launcher may import this package before jax
+exists in the process; the checkpoint-corruption helper touches only the
+filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from .plan import ChaosFault, ChaosPlan
+
+__all__ = ["ChaosInjector", "corrupt_newest_checkpoint"]
+
+# Payload bytes for checkpoint corruption: long enough to guarantee any
+# parser/checksum downstream sees garbage, loud enough to grep in a hexdump.
+_GARBAGE = b"\xde\xad\xbe\xef CHAOS-CORRUPTED " * 8
+
+# orbax's commit marker — corruption must leave it intact so the torn
+# checkpoint still LOOKS finalized and exercises the restore walk-back
+# (deleting it would exercise the cheaper discovery-skip path instead).
+_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+
+
+def corrupt_newest_checkpoint(directory: str) -> Optional[str]:
+    """Garble the payload of the newest finalized ``model_*`` checkpoint
+    under ``directory`` (every file except the commit marker gets its head
+    overwritten). Returns the corrupted path, or None when there is no
+    finalized checkpoint to corrupt. Local-filesystem only — chaos runs
+    are dev rings."""
+    best: Optional[str] = None
+    best_step = -1
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        if not name.startswith("model_") or ".orbax-checkpoint-tmp" in name:
+            continue
+        digits = name[len("model_"):]
+        if not digits.isdigit():
+            continue
+        path = os.path.join(directory, name)
+        if not any(os.path.exists(os.path.join(path, m))
+                   for m in _COMMIT_MARKERS):
+            continue  # torn already — corrupt a checkpoint resume WOULD pick
+        if int(digits) > best_step:
+            best_step, best = int(digits), path
+    if best is None:
+        return None
+    for root, _, files in os.walk(best):
+        for fname in files:
+            if fname in _COMMIT_MARKERS:
+                continue
+            fpath = os.path.join(root, fname)
+            try:
+                with open(fpath, "r+b") as f:
+                    f.write(_GARBAGE)
+            except OSError:
+                pass  # a file we cannot open is already damage enough
+    return best
+
+
+class ChaosInjector:
+    """Fires plan faults from the trainer's hook points.
+
+    ``run_dir`` anchors the once-per-run markers; when the trainer passes
+    no checkpoint dir (bench loops), markers degrade to in-process memory
+    — enough for single-attempt use, while multi-attempt kill/restart
+    scenarios always have a run dir by construction (that is where the
+    checkpoint being resumed lives)."""
+
+    def __init__(self, plan: ChaosPlan, rank: int = 0,
+                 run_dir: str = "") -> None:
+        self.plan = plan
+        self.rank = rank
+        self.run_dir = run_dir
+        self._fired_mem: set = set()
+
+    # ------------------------------------------------------------- markers
+
+    def _marker(self, idx: int) -> str:
+        return os.path.join(self.run_dir, f".chaos_fired_{idx:02d}")
+
+    def _already_fired(self, idx: int) -> bool:
+        if idx in self._fired_mem:
+            return True
+        return bool(self.run_dir) and os.path.exists(self._marker(idx))
+
+    def _mark_fired(self, idx: int, fault: ChaosFault) -> None:
+        # Marker lands BEFORE the fault executes: a SIGKILL leaves no
+        # chance to write afterwards, and a re-fired kill every attempt
+        # would be an unrecoverable crash loop, not an injected fault.
+        self._fired_mem.add(idx)
+        if self.run_dir:
+            with open(self._marker(idx), "w") as f:
+                f.write(f"{fault.kind} step={fault.step} rank={fault.rank} "
+                        f"t={time.time():.3f}\n")
+
+    # --------------------------------------------------------------- hooks
+
+    def _due(self, step: int, kinds) -> list:
+        return [(i, f) for i, f in enumerate(self.plan.faults)
+                if f.kind in kinds and f.rank == self.rank
+                and f.step == step and not self._already_fired(i)]
+
+    def _fire_kill(self, fault: ChaosFault) -> None:
+        sig = getattr(signal, fault.sig, None)
+        if not isinstance(sig, signal.Signals):
+            raise ValueError(f"chaos kill: unknown signal {fault.sig!r}")
+        print(f"[chaos] rank {self.rank}: {fault.sig} self at step "
+              f"{fault.step}", file=sys.stderr, flush=True)
+        os.kill(os.getpid(), sig)
+        # SIGTERM may be handled/deferred by the host loop; SIGKILL never
+        # returns here. Either way the fault's job is done.
+
+    def on_step(self, loop) -> None:
+        """Top of ``run_step``: corrupt/kill faults scheduled for the step
+        ABOUT to run (plan order — corrupt-then-kill at the same step is
+        the classic 'newest checkpoint is garbage AND the worker died')."""
+        for idx, fault in self._due(loop.step,
+                                    ("corrupt_checkpoint", "kill")):
+            self._mark_fired(idx, fault)
+            if fault.kind == "corrupt_checkpoint":
+                victim = corrupt_newest_checkpoint(
+                    self.run_dir or loop.checkpoint_dir)
+                print(f"[chaos] rank {self.rank}: corrupted checkpoint "
+                      f"{victim}", file=sys.stderr, flush=True)
+            else:
+                self._fire_kill(fault)
+
+    def on_data(self, loop) -> float:
+        """Before pulling the batch for the NEXT step: stall faults.
+        Returns the injected stall seconds (the caller attributes them to
+        the data-wait gauge, so goodput accounting sees the stall as the
+        input-pipeline time it simulates)."""
+        stalled = 0.0
+        for idx, fault in self._due(loop.step, ("stall_data",)):
+            self._mark_fired(idx, fault)
+            print(f"[chaos] rank {self.rank}: stalling data "
+                  f"{fault.seconds}s at step {fault.step}",
+                  file=sys.stderr, flush=True)
+            time.sleep(fault.seconds)
+            stalled += fault.seconds
+        return stalled
+
+    def on_save(self, loop) -> None:
+        """Right after a checkpoint save is SCHEDULED (async write in
+        flight, finalize not reached): crash_in_save faults — the kill
+        lands between the array write and finalize, leaving an
+        unfinalized/torn checkpoint behind."""
+        for idx, fault in self._due(loop.step, ("crash_in_save",)):
+            self._mark_fired(idx, fault)
+            print(f"[chaos] rank {self.rank}: SIGKILL mid-save at step "
+                  f"{fault.step}", file=sys.stderr, flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)

@@ -1,0 +1,120 @@
+"""ChaosPlan: a declarative schedule of faults to inject into a live run.
+
+The plan is plain JSON so it travels every channel a config does —
+``--chaos_plan`` on the CLI, a field in ``--config_json``, or the
+``DPT_CHAOS_PLAN`` environment variable, which (like ``DPT_PREFETCH_DEPTH``)
+is inherited by every worker of every restart attempt the launcher spawns:
+the one channel that reaches a ``--config_json`` ring without minting a new
+config file.
+
+Schema::
+
+    {"faults": [
+        {"kind": "kill",               "step": 3, "rank": 1,
+         "sig": "SIGKILL"},
+        {"kind": "crash_in_save",      "step": 6, "rank": 0},
+        {"kind": "stall_data",         "step": 2, "rank": 0,
+         "seconds": 1.5},
+        {"kind": "corrupt_checkpoint", "step": 5, "rank": 0}
+    ]}
+
+Fault kinds (executed by :mod:`.inject`):
+
+* ``kill`` — the targeted rank sends itself ``sig`` (SIGKILL/SIGTERM/...)
+  at the top of optimizer step ``step`` (a worker dying mid-step);
+* ``crash_in_save`` — the targeted rank SIGKILLs itself right after the
+  checkpoint save at ``step`` is SCHEDULED, i.e. between the array write
+  and finalize — leaving an unfinalized/torn checkpoint on disk;
+* ``stall_data`` — the targeted rank's data iterator blocks ``seconds``
+  before yielding the batch at ``step`` (a wedged input pipeline);
+* ``corrupt_checkpoint`` — garbles the payload of the newest FINALIZED
+  checkpoint in the run dir at ``step`` (bit rot / torn replication: the
+  directory still looks committed, but restore fails — the case the
+  resume walk-back exists for).
+
+This module must stay import-light (no jax): the launcher and tests read
+plans before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List
+
+__all__ = ["ChaosFault", "ChaosPlan", "CHAOS_PLAN_ENV"]
+
+CHAOS_PLAN_ENV = "DPT_CHAOS_PLAN"
+
+_KINDS = ("kill", "crash_in_save", "stall_data", "corrupt_checkpoint")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosFault:
+    """One scheduled fault. ``rank`` targets a single process (faults on
+    other ranks no-op), so a plan can kill worker 1 mid-step while worker
+    0 keeps serving the coordinator."""
+
+    kind: str
+    step: int
+    rank: int = 0
+    sig: str = "SIGKILL"      # kill only
+    seconds: float = 1.0      # stall_data only
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown chaos fault kind {self.kind!r} "
+                             f"(expected one of {_KINDS})")
+        if self.step < 0:
+            raise ValueError(f"chaos fault step must be >= 0, got {self.step}")
+        if self.kind == "stall_data" and self.seconds <= 0:
+            raise ValueError("stall_data fault needs seconds > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    faults: tuple
+
+    @classmethod
+    def parse(cls, src: str) -> "ChaosPlan":
+        """Build a plan from inline JSON, ``@/path/to/plan.json``, or a
+        bare path to an existing file. Raises ValueError on anything
+        malformed — a chaos run with a silently-empty plan would 'pass'
+        without testing anything."""
+        text = src.strip()
+        if text.startswith("@"):
+            with open(text[1:]) as f:
+                text = f.read()
+        elif not text.startswith("{") and os.path.exists(text):
+            with open(text) as f:
+                text = f.read()
+        try:
+            payload = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"chaos plan is not valid JSON: {e}") from e
+        raw = payload.get("faults") if isinstance(payload, dict) else payload
+        if not isinstance(raw, list) or not raw:
+            raise ValueError("chaos plan must carry a non-empty 'faults' list")
+        faults: List[ChaosFault] = []
+        for i, f in enumerate(raw):
+            if not isinstance(f, dict):
+                raise ValueError(f"chaos fault #{i} must be an object")
+            known = {k: f[k] for k in
+                     ("kind", "step", "rank", "sig", "seconds") if k in f}
+            if set(f) - set(known):
+                raise ValueError(f"chaos fault #{i} has unknown keys "
+                                 f"{sorted(set(f) - set(known))}")
+            faults.append(ChaosFault(**known))
+        return cls(faults=tuple(faults))
+
+    def describe(self) -> str:
+        return "; ".join(
+            f"{f.kind}@step{f.step}/rank{f.rank}"
+            + (f" {f.sig}" if f.kind == "kill" else "")
+            + (f" {f.seconds}s" if f.kind == "stall_data" else "")
+            for f in self.faults)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"faults": [dataclasses.asdict(f) for f in self.faults]})

@@ -79,6 +79,14 @@ class GeneralSettings(S):
            "while step N dispatches instead of blocking on the step just "
            "enqueued; logged values are exact, just k steps late (flushed "
            "at eval/checkpoint/exit boundaries); 0 = eager")
+    chaos_plan: str = _(
+        "", "fault-injection schedule (chaos harness): inline JSON, "
+            "@/path/to/plan.json, or a bare path — faults like "
+            '{"kind": "kill", "step": N, "rank": R} / crash_in_save / '
+            "stall_data / corrupt_checkpoint fire at exact optimizer "
+            "steps to prove the restart+resume stack survives them; the "
+            "DPT_CHAOS_PLAN env var overrides (it reaches --config_json "
+            "ring workers like DPT_PREFETCH_DEPTH does); empty disables")
 
 
 class DataSettings(S):

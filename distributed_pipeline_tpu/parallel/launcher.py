@@ -24,8 +24,11 @@ import argparse
 import os
 import subprocess
 import sys
+import tempfile
+import time
 from typing import List, Optional, Sequence, Tuple
 
+from ..chaos import goodput as goodput_lib
 from .dist import AUTORUN_ENV_FLAG, find_free_port, is_available
 
 __all__ = [
@@ -58,9 +61,26 @@ def create_distributed_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices_per_proc", type=int, default=2,
                    help="fake CPU devices per spawned local worker")
     p.add_argument("--max_restarts", type=int, default=0,
-                   help="respawn the worker ring this many times after a "
-                        "failure; checkpoint auto-resume continues the run "
+                   help="restart-rate budget: respawn the worker ring after "
+                        "a failure, at most this many times per sliding "
+                        "--restart_window_s window (not a lifetime counter "
+                        "— a week-long spot-capacity run may restart "
+                        "hundreds of times, just not in a tight loop); "
+                        "checkpoint auto-resume continues the run "
                         "(reference dist_run.py:123-129)")
+    p.add_argument("--restart_window_s", type=float, default=3600.0,
+                   help="sliding window (seconds) the --max_restarts budget "
+                        "applies to; restarts older than this no longer "
+                        "count against the budget. <= 0 restores lifetime "
+                        "counting")
+    p.add_argument("--restart_backoff_s", type=float, default=1.0,
+                   help="base seconds of exponential backoff between "
+                        "restart attempts (doubles per consecutive "
+                        "failure, capped by --restart_backoff_max_s; "
+                        "0 disables). A crashing dependency gets breathing "
+                        "room instead of a spawn storm")
+    p.add_argument("--restart_backoff_max_s", type=float, default=30.0,
+                   help="cap on the exponential restart backoff")
     p.add_argument("--monitor_interval", type=float, default=0.2,
                    help="seconds between worker liveness polls (reference "
                         "dist_run.py:130-136; default is snappier than "
@@ -91,8 +111,9 @@ def parse_distributed_args(
     epilog = ("launcher options: --distributed "
               "[--coordinator_address H:P] [--num_processes N] "
               "[--process_id I] [--nprocs N] [--devices_per_proc K] "
-              "[--max_restarts R] [--monitor_interval S] "
-              "[--log_dir DIR] [--log_tee]")
+              "[--max_restarts R] [--restart_window_s S] "
+              "[--restart_backoff_s S] [--restart_backoff_max_s S] "
+              "[--monitor_interval S] [--log_dir DIR] [--log_tee]")
     if epilog not in (parser.epilog or ""):
         parser.epilog = ((parser.epilog or "") + "\n\n" + epilog)
     return dist_ns, rest
@@ -139,7 +160,8 @@ def _tee_pump(proc, sink, prefix: str):
 
 def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
                 run_timestamp: Optional[str] = None,
-                cache_dir: str = "") -> dict:
+                cache_dir: str = "",
+                extra_env: Optional[dict] = None) -> dict:
     """Environment for spawned worker ``i`` — the ring coordinates plus the
     persistent-compilation-cache propagation: every worker (and every
     restart attempt) points at the SAME cache dir, so only the first ring
@@ -171,6 +193,10 @@ def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
         + f"--xla_force_host_platform_device_count="
           f"{devices_per_proc}",
     })
+    # Supervision channel (restart accounting): DPT_ATTEMPT / DPT_SPAWN_T /
+    # DPT_RUN_DIR_FILE ride here — launcher-owned keys win over anything
+    # inherited from the caller's environ.
+    env.update(extra_env or {})
     return env
 
 
@@ -178,7 +204,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      monitor_interval: float,
                      run_timestamp: Optional[str] = None,
                      log_dir: str = "", log_tee: bool = False,
-                     cache_dir: str = "") -> int:
+                     cache_dir: str = "", attempt: int = 0,
+                     extra_env: Optional[dict] = None) -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -186,11 +213,10 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     terminate them instead (torchrun's elastic agent behavior). Returns the
     max worker exit code.
     """
-    import time
-
     port = find_free_port()
     coord = f"127.0.0.1:{port}"
-    print(f"[launcher] spawning {nprocs} local workers, coordinator {coord}")
+    print(f"[launcher] attempt {attempt}: spawning {nprocs} local workers, "
+          f"coordinator {coord}")
     print(f"[launcher] worker cmd: {' '.join(cmd_base)}")  # cmdline echo,
     # like reference dist_run.py:36-44
     logs = []
@@ -209,11 +235,16 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     try:
         for i in range(nprocs):
             env = _worker_env(i, nprocs, coord, devices_per_proc,
-                              run_timestamp, cache_dir)
+                              run_timestamp, cache_dir, extra_env=extra_env)
             if log_dir:
                 # append: a restarted ring continues the same files (the
                 # attempt boundary is visible from the launcher's own log)
                 f = open(os.path.join(log_dir, f"worker_{i}.log"), "ab")
+                # Attempt header: respawned rings append to the same file,
+                # so without a boundary line the interleaved output of N
+                # attempts is unattributable when debugging a crash loop.
+                f.write(f"[launcher] attempt {attempt}\n".encode())
+                f.flush()
                 logs.append(f)
                 if log_tee:
                     # pipe through a pump thread: file AND console get
@@ -267,20 +298,152 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     return next((c for c in codes if c not in (None, 0)), 0)
 
 
+class _RestartBudget:
+    """R restarts per sliding window, not a lifetime counter: a week-long
+    spot-capacity run legitimately restarts hundreds of times — what must
+    be stopped is a tight crash loop. ``window_s <= 0`` restores lifetime
+    counting (every restart counts forever)."""
+
+    def __init__(self, max_restarts: int, window_s: float,
+                 now=time.monotonic) -> None:
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self._now = now
+        self._stamps: List[float] = []
+
+    def spent(self) -> int:
+        if self.window_s > 0:
+            cutoff = self._now() - self.window_s
+            self._stamps = [t for t in self._stamps if t >= cutoff]
+        return len(self._stamps)
+
+    def allows_restart(self) -> bool:
+        return self.spent() < self.max_restarts
+
+    def charge(self) -> None:
+        self._stamps.append(self._now())
+
+
+def _crash_looping(records: List[dict]) -> bool:
+    """Two consecutive FAILED attempts with zero step progress: the run is
+    not recovering, it is burning restarts — stop now rather than when the
+    budget runs out. Attempts whose progress is unknown (no beacons: the
+    wrapped script is not a TrainLoop run) never trigger this."""
+    if len(records) < 2:
+        return False
+    for rec in records[-2:]:
+        if rec.get("rc", 1) == 0 or rec.get("steps") is None \
+                or rec.get("steps", 0) > 0:
+            return False
+    return True
+
+
+def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
+                     t_spawn: float, t_exit: float, prev_t_exit: float,
+                     prev_max_step: Optional[int]) -> Tuple[dict,
+                                                            Optional[str]]:
+    """Build the structured per-attempt record and locate the run dir.
+
+    The run dir is learned through a handshake file the workers write
+    (run/train.py / TrainLoop stamp their resolved checkpoint dir into
+    ``DPT_RUN_DIR_FILE``) — the launcher cannot re-derive it without
+    duplicating the script's dir logic. Step progress and the post-mortem
+    goodput snapshot come from the per-rank beacons in that dir."""
+    run_dir: Optional[str] = None
+    try:
+        with open(run_dir_file) as f:
+            run_dir = f.read().strip() or None
+    except OSError:
+        run_dir = None
+    end_step: Optional[int] = None
+    start_step = prev_max_step
+    beacon_goodput = None
+    resume_overhead = None
+    recompiles = steady_recompiles = None
+    if run_dir and os.path.isdir(run_dir):
+        beacons = goodput_lib.read_beacons(run_dir)
+        ours = {r: b for r, b in beacons.items()
+                if int(b.get("attempt", 0)) == attempt}
+        if beacons and not ours:
+            # The run IS beacon-capable (earlier attempts reported), but
+            # this attempt died before its first step — that is zero
+            # progress, not unknown progress: the crash-loop fail-fast
+            # must see it (an attempt that cannot even restore would
+            # otherwise burn the whole restart budget).
+            end_step = prev_max_step or 0
+        if ours:
+            end_step = max(int(b.get("step", 0)) for b in ours.values())
+            # Progress is measured against the step THIS attempt restored
+            # from (the beacon's start_step), not the run's high-water
+            # mark: after a walk-back past a corrupt checkpoint an attempt
+            # legitimately advances below the old maximum, and calling
+            # that zero progress would let the crash-loop fail-fast kill
+            # a recovering run.
+            starts = [int(b["start_step"]) for b in ours.values()
+                      if b.get("start_step") is not None]
+            if starts:
+                start_step = min(starts)
+            # rank 0's beacon carries the attempt's goodput snapshot (the
+            # flight recorder aggregate_run falls back to when the attempt
+            # died before writing its clean-exit sidecar)
+            b0 = ours.get(0) or next(iter(ours.values()))
+            beacon_goodput = b0.get("goodput")
+            recompiles = b0.get("recompile_count")
+            steady_recompiles = b0.get("steady_recompile_count")
+            if isinstance(beacon_goodput, dict):
+                resume_overhead = (beacon_goodput.get("startup_s", 0.0)
+                                   + beacon_goodput.get("restore_s", 0.0)
+                                   + beacon_goodput.get("compile_s", 0.0))
+    steps = (None if end_step is None
+             else max(0, end_step - (start_step or 0)))
+    record = {
+        "attempt": attempt,
+        "rc": rc,
+        "t_spawn": round(t_spawn, 3),
+        "t_exit": round(t_exit, 3),
+        "duration_s": round(t_exit - t_spawn, 3),
+        "downtime_s": round(max(0.0, t_spawn - prev_t_exit), 3)
+        if prev_t_exit else 0.0,
+        "start_step": start_step,
+        "end_step": end_step,
+        "steps": steps,
+        "resume_overhead_s": (round(resume_overhead, 3)
+                              if resume_overhead is not None else None),
+        "recompile_count": recompiles,
+        "steady_recompile_count": steady_recompiles,
+        "goodput": beacon_goodput,
+    }
+    return record, run_dir
+
+
 def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             nprocs: int, devices_per_proc: int = 2,
                             max_restarts: int = 0,
                             monitor_interval: float = 0.2,
                             log_dir: str = "", log_tee: bool = False,
-                            cache_dir: Optional[str] = None) -> int:
+                            cache_dir: Optional[str] = None,
+                            restart_window_s: float = 3600.0,
+                            restart_backoff_s: float = 1.0,
+                            restart_backoff_max_s: float = 30.0) -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
     Restart supervision (reference torch.elastic via ``--max_restarts``,
-    dist_run.py:123-136 + SURVEY.md §5.3 recovery story): when the ring dies
-    and restarts remain, the whole ring is respawned on a fresh coordinator
-    port; workers rediscover the newest checkpoint in their run dir and
-    resume (utils/checkpoint.py auto-resume contract).
+    dist_run.py:123-136 + SURVEY.md §5.3 recovery story), hardened for
+    chaos (ISSUE 8): when the ring dies, the whole ring is respawned on a
+    fresh coordinator port and workers resume from the newest restorable
+    checkpoint in their run dir. Between attempts the launcher
+
+    * sleeps an EXPONENTIAL BACKOFF (``restart_backoff_s`` doubling per
+      consecutive failure up to ``restart_backoff_max_s``),
+    * charges a RESTART-RATE BUDGET (``max_restarts`` per sliding
+      ``restart_window_s`` window — not a lifetime counter),
+    * FAILS FAST on a crash loop (two consecutive attempts with zero step
+      progress stop the run: restarts are not fixing anything), and
+    * appends a structured record to ``attempts.jsonl`` in the run dir
+      (attempt, rc, duration, step progress, downtime, resume overhead,
+      post-mortem goodput snapshot) so every second of the run stays
+      attributable (chaos.goodput.aggregate_run).
 
     Reference equivalent: in-process ``torch.distributed.run.run``
     (dist_run.py:13-54). Returns the final attempt's max worker exit code.
@@ -295,7 +458,6 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     # Passed to the WORKERS' env only — mutating this process's environ
     # would leak the timestamp into a second launch from the same process,
     # silently resuming run 2 from run 1's checkpoints.
-    import time
     run_timestamp = os.environ.get("DPT_RUN_TIMESTAMP") or time.strftime(
         "%Y%m%d-%H%M%S")
     # Compilation-cache propagation: an explicit cache_dir (or one already
@@ -307,17 +469,77 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     # since DPT_RUN_TIMESTAMP pins one shared run dir.)
     if cache_dir is None:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+    budget = _RestartBudget(max_restarts, restart_window_s)
+    fd, run_dir_file = tempfile.mkstemp(prefix="dpt_run_dir_")
+    os.close(fd)
+    records: List[dict] = []
     attempt = 0
-    while True:
-        code = _run_worker_ring(cmd_base, nprocs, devices_per_proc,
-                                monitor_interval, run_timestamp,
-                                log_dir=log_dir, log_tee=log_tee,
-                                cache_dir=cache_dir)
-        if code == 0 or attempt >= max_restarts:
-            return code
-        attempt += 1
-        print(f"[launcher] ring failed (rc={code}); "
-              f"restart {attempt}/{max_restarts}")
+    consecutive_failures = 0
+    prev_t_exit = 0.0
+    prev_max_step: Optional[int] = None
+    try:
+        while True:
+            t_spawn = time.time()
+            code = _run_worker_ring(
+                cmd_base, nprocs, devices_per_proc, monitor_interval,
+                run_timestamp, log_dir=log_dir, log_tee=log_tee,
+                cache_dir=cache_dir, attempt=attempt,
+                extra_env={"DPT_ATTEMPT": str(attempt),
+                           "DPT_SPAWN_T": repr(t_spawn),
+                           "DPT_RUN_DIR_FILE": run_dir_file})
+            t_exit = time.time()
+            record, run_dir = _harvest_attempt(
+                run_dir_file, attempt, code, t_spawn, t_exit, prev_t_exit,
+                prev_max_step)
+            records.append(record)
+            if run_dir and os.path.isdir(run_dir):
+                try:
+                    goodput_lib.append_attempt(run_dir, record)
+                except OSError as e:
+                    print(f"[launcher] attempts.jsonl write failed: {e}")
+            prev_t_exit = t_exit
+            if record["end_step"] is not None:
+                prev_max_step = max(prev_max_step or 0, record["end_step"])
+            if code == 0:
+                return 0
+            # "Consecutive" failures reset when an attempt made real step
+            # progress: a preemption after hours of healthy training is
+            # not a tightening crash loop, and must not inherit the
+            # accumulated backoff of unrelated failures days earlier.
+            if (record["steps"] or 0) > 0:
+                consecutive_failures = 1
+            else:
+                consecutive_failures += 1
+            if _crash_looping(records):
+                print(f"[launcher] crash loop: last 2 attempts made zero "
+                      f"step progress (rc={code}); failing fast instead of "
+                      f"burning {max_restarts - budget.spent()} more "
+                      f"restart(s)")
+                return code
+            if not budget.allows_restart():
+                window = (f"in the last {restart_window_s:.0f}s"
+                          if restart_window_s > 0 else "total")
+                print(f"[launcher] ring failed (rc={code}); restart budget "
+                      f"exhausted ({budget.spent()}/{max_restarts} "
+                      f"{window})")
+                return code
+            budget.charge()
+            backoff = 0.0
+            if restart_backoff_s > 0:
+                backoff = min(restart_backoff_max_s,
+                              restart_backoff_s
+                              * (2.0 ** (consecutive_failures - 1)))
+            attempt += 1
+            print(f"[launcher] ring failed (rc={code}); restart "
+                  f"{budget.spent()}/{max_restarts} (window "
+                  f"{restart_window_s:.0f}s), backoff {backoff:.1f}s")
+            if backoff > 0:
+                time.sleep(backoff)
+    finally:
+        try:
+            os.unlink(run_dir_file)
+        except OSError:
+            pass
 
 
 def parse_and_autorun(
@@ -336,17 +558,25 @@ def parse_and_autorun(
     """
     dist_ns, script_argv = parse_distributed_args(parser, argv)
 
-    if dist_ns.distributed and dist_ns.nprocs > 1:
+    # --nprocs 1 is a real (supervised) ring too: one spawned worker under
+    # the launcher's restart/backoff/crash-loop machinery — the elastic
+    # recovery story without cross-process collectives (which this image's
+    # jax cannot run on CPU; see CHANGES r6).
+    if dist_ns.distributed and dist_ns.nprocs >= 1:
         modname = get_main_modname()
         if modname is None:
             raise RuntimeError(
                 "--nprocs relaunch requires running as a module (python -m ...)")
-        code = run_argv_as_distributed(modname, script_argv, dist_ns.nprocs,
-                                       dist_ns.devices_per_proc,
-                                       max_restarts=dist_ns.max_restarts,
-                                       monitor_interval=dist_ns.monitor_interval,
-                                       log_dir=dist_ns.log_dir,
-                                       log_tee=dist_ns.log_tee)
+        code = run_argv_as_distributed(
+            modname, script_argv, dist_ns.nprocs,
+            dist_ns.devices_per_proc,
+            max_restarts=dist_ns.max_restarts,
+            monitor_interval=dist_ns.monitor_interval,
+            log_dir=dist_ns.log_dir,
+            log_tee=dist_ns.log_tee,
+            restart_window_s=dist_ns.restart_window_s,
+            restart_backoff_s=dist_ns.restart_backoff_s,
+            restart_backoff_max_s=dist_ns.restart_backoff_max_s)
         sys.exit(code)
 
     if dist_ns.distributed:
@@ -376,7 +606,6 @@ def parse_and_autorun(
             # this environment would silently resume that run's checkpoints.
             # Workers (process_id > 0) inherit the value the coordinator's
             # echoed command gave them.
-            import time
             if dist_ns.process_id in (None, 0):
                 os.environ["DPT_RUN_TIMESTAMP"] = time.strftime(
                     "%Y%m%d-%H%M%S")

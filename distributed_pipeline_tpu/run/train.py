@@ -82,44 +82,30 @@ def main(namespace: argparse.Namespace) -> None:
     if cache_dir:
         logger.info(f"persistent compilation cache: {cache_dir}")
 
-    # Exact-resume data order: find the step this run will resume from
-    # (same discovery TrainLoop does) and fast-forward both streams so the
-    # continued run consumes the batches the uninterrupted one would have
-    # — together with the step-derived train RNG this makes a resumed run
-    # bit-identical. One train step eats one train batch; eval eats one
-    # batch per eval_interval steps.
-    from ..utils.checkpoint import load_meta, resume_target
-    resume_step, resume_path = resume_target(ckpt_path,
-                                             args.resume_checkpoint)
-    # meta travels WITH the checkpoint: read it from the directory the
-    # resolved model_ lives in (an explicit --resume_checkpoint may point
-    # into another run's dir — the run dir could hold a stale sidecar for
-    # the same step number)
-    meta = (load_meta(os.path.dirname(resume_path.rstrip("/")), resume_step)
-            if resume_step else None)
-    if meta is not None and "eval_batches_consumed" in meta:
-        # the checkpoint records exactly how many eval batches were drawn
-        # — the fast-forward no longer assumes --eval_interval is
-        # unchanged (r4 advisor: 'a warning is not a contract')
-        eval_skip = int(meta["eval_batches_consumed"])
-    else:
-        eval_skip = resume_step // max(args.eval_interval, 1)
-        if resume_step and rank == 0:
-            # pre-meta checkpoint: the division assumes the flag matches
-            logger.warn(
-                f"checkpoint has no meta sidecar; eval-stream "
-                f"fast-forward assumes --eval_interval "
-                f"({args.eval_interval}) is unchanged from the original "
-                f"run (train stream is exact either way)")
-    if resume_step and rank == 0:
-        logger.info(f"fast-forwarding data stream past {resume_step} "
-                    f"consumed train batches / {eval_skip} eval batches "
-                    f"(exact-order resume)")
-    data = load_data_from_args("train", skip_batches=resume_step,
-                               **args.dict())
-    eval_data = load_data_from_args(
-        "valid", skip_batches=eval_skip,
-        **{**args.dict(), "deterministic": True})
+    # Run-dir handshake with the launcher (restart supervision): stamp the
+    # resolved run dir into the file the launcher named, EARLY — even an
+    # attempt that dies during model build then gets its attempts.jsonl
+    # record in the right place.
+    run_dir_file = os.environ.get("DPT_RUN_DIR_FILE")
+    if run_dir_file and rank == 0:
+        try:
+            with open(run_dir_file, "w") as f:
+                f.write(ckpt_path if "://" in ckpt_path
+                        else os.path.abspath(ckpt_path))
+        except OSError:
+            pass
+
+    # Chaos harness (fault injection): a ChaosPlan from the config field or
+    # the DPT_CHAOS_PLAN env override — the env rides the launcher's worker
+    # environment, so it reaches --config_json rings like
+    # DPT_PREFETCH_DEPTH does.
+    from ..chaos import CHAOS_PLAN_ENV, ChaosInjector, ChaosPlan
+    chaos = None
+    chaos_src = os.environ.get(CHAOS_PLAN_ENV) or args.chaos_plan
+    if chaos_src:
+        chaos = ChaosInjector(ChaosPlan.parse(chaos_src), rank=rank,
+                              run_dir=ckpt_path)
+        logger.info(f"chaos plan armed: {chaos.plan.describe()}")
 
     if args.pipe > 1 and not args.scan_layers:
         raise SystemExit("--pipe > 1 requires --scan_layers true (stacked "
@@ -175,10 +161,18 @@ def main(namespace: argparse.Namespace) -> None:
     dispatch_lag = int(os.environ.get("DPT_DISPATCH_LAG")
                        or args.dispatch_lag)
 
+    # Two-phase wiring: the loop RESTORES FIRST (discovery, orbax reads,
+    # and — when the newest checkpoint is corrupt — the walk-back to an
+    # older one all live inside restore_resume_state), then the data
+    # streams are fast-forwarded to the step ACTUALLY restored. The old
+    # order resolved the resume target before construction, which a
+    # walk-back would silently desync from the data stream.
+    from ..chaos.goodput import beacon_max_step
+    from ..utils.checkpoint import load_meta
     loop = TrainLoop(
         model=workload,
-        data=data,
-        eval_data=eval_data,
+        data=None,
+        eval_data=None,
         eval_callbacks=eval_callbacks,
         batch_size=args.batch_size,
         microbatch=args.microbatch,
@@ -187,10 +181,7 @@ def main(namespace: argparse.Namespace) -> None:
         log_interval=args.log_interval,
         eval_interval=args.eval_interval,
         save_interval=args.save_interval,
-        # The path resolved above, not args.resume_checkpoint: one discovery,
-        # so the stream fast-forward and the restored state cannot desync.
-        resume_checkpoint=resume_path,
-        eval_batches_consumed=eval_skip,
+        resume_checkpoint=args.resume_checkpoint,
         gradient_clipping=args.gradient_clipping,
         weight_decay=args.weight_decay,
         learning_steps=args.learning_steps,
@@ -203,7 +194,51 @@ def main(namespace: argparse.Namespace) -> None:
         sanitize=args.sanitize,
         prefetch_depth=prefetch_depth,
         dispatch_lag=dispatch_lag,
+        chaos=chaos,
+        # Steps an earlier attempt already reached (per the progress
+        # beacons) book as recompute, not useful — goodput accounting for
+        # the lost last-checkpoint..crash window.
+        recompute_until_step=beacon_max_step(ckpt_path),
     )
+
+    # Exact-resume data order: fast-forward both streams so the continued
+    # run consumes the batches the uninterrupted one would have — together
+    # with the step-derived train RNG this makes a resumed run
+    # bit-identical. One train step eats one train batch; eval eats one
+    # batch per eval_interval steps.
+    resume_step = loop.step
+    # meta travels WITH the checkpoint: read it from the directory the
+    # restored model_ lives in (an explicit --resume_checkpoint may point
+    # into another run's dir — the run dir could hold a stale sidecar for
+    # the same step number)
+    meta = (load_meta(os.path.dirname(loop.resumed_from.rstrip("/")),
+                      resume_step)
+            if resume_step and loop.resumed_from else None)
+    if meta is not None and "eval_batches_consumed" in meta:
+        # the checkpoint records exactly how many eval batches were drawn
+        # — the fast-forward no longer assumes --eval_interval is
+        # unchanged (r4 advisor: 'a warning is not a contract')
+        eval_skip = int(meta["eval_batches_consumed"])
+    else:
+        eval_skip = resume_step // max(args.eval_interval, 1)
+        if resume_step and rank == 0:
+            # pre-meta checkpoint: the division assumes the flag matches
+            logger.warn(
+                f"checkpoint has no meta sidecar; eval-stream "
+                f"fast-forward assumes --eval_interval "
+                f"({args.eval_interval}) is unchanged from the original "
+                f"run (train stream is exact either way)")
+    if resume_step and rank == 0:
+        logger.info(f"fast-forwarding data stream past {resume_step} "
+                    f"consumed train batches / {eval_skip} eval batches "
+                    f"(exact-order resume)")
+    loop.set_data(
+        load_data_from_args("train", skip_batches=resume_step,
+                            **args.dict()),
+        eval_data=load_data_from_args(
+            "valid", skip_batches=eval_skip,
+            **{**args.dict(), "deterministic": True}),
+        eval_batches_consumed=eval_skip)
     n_m = loop.n_params / 1e6
     logger.info(f"the parameter count is {loop.n_params} ({n_m:.1f}M)")
     loop.run_loop()

@@ -35,6 +35,7 @@ __all__ = [
     "restore_resume_state", "resume_target",
     "parse_step_from_name", "find_resume_checkpoint", "find_ema_checkpoint",
     "find_opt_checkpoint", "latest_step", "prune_checkpoints",
+    "in_flight_steps",
 ]
 
 _STEP_RE = re.compile(r"(\d{6,})$")
@@ -55,6 +56,32 @@ def parse_step_from_name(name: str) -> Optional[int]:
 
 _ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp"
 
+# Finalization markers orbax leaves INSIDE a committed checkpoint dir:
+# _CHECKPOINT_METADATA on the rename-atomic (local fs) protocol, and
+# commit_success.txt on in-place backends (gs://) where the final NAME
+# exists for the whole write and only the marker says "durable".
+_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+
+# Saves scheduled but not yet durable in THIS process: {(abs_dir, step)}.
+# AsyncSaver registers/clears them so retention pruning can never rank or
+# delete a checkpoint whose background write is still in flight (on
+# in-place backends the dir already carries its final name mid-write).
+_IN_FLIGHT: set = set()
+
+
+def _norm_dir(directory: str) -> str:
+    """The directory key used by the in-flight registry (absolute for
+    local paths, verbatim for URIs — mirrors AsyncSaver's path logic)."""
+    if "://" in directory:
+        return directory.rstrip("/")
+    return os.path.abspath(directory)
+
+
+def in_flight_steps(directory: str) -> set:
+    """Steps with a save scheduled by this process that is not yet durable."""
+    key = _norm_dir(directory)
+    return {s for d, s in _IN_FLIGHT if d == key}
+
 
 def _is_unfinalized(name: str) -> bool:
     """Orbax writes into ``<name>.orbax-checkpoint-tmp-<timestamp>`` and
@@ -66,9 +93,27 @@ def _is_unfinalized(name: str) -> bool:
     return _ORBAX_TMP_MARKER in name
 
 
-def _scan(directory: str, prefix: str) -> List[Tuple[int, str]]:
+def _looks_finalized(path: "epath.Path") -> bool:
+    """True when the checkpoint dir carries orbax's commit marker. A dir
+    with its FINAL name but no marker is a torn save: an in-place write
+    that crashed between the array write and finalize (or another
+    process's write still in flight) — auto-resume must skip it and
+    retention must not count or delete it."""
+    try:
+        return any((path / m).exists() for m in _COMMIT_MARKERS)
+    except Exception:
+        return False  # unreadable == not restorable; treat as torn
+
+
+def _scan(directory: str, prefix: str,
+          finalized_only: bool = False) -> List[Tuple[int, str]]:
     if not directory:
         return []
+    # Absolute paths out (local only; URIs pass through): orbax REJECTS
+    # relative restore paths, so discovery from a cwd-relative run dir
+    # (run/train.py's default model_checkpoints/...) must not hand the
+    # restore a path it will refuse.
+    directory = _norm_dir(directory)
     d = epath.Path(directory)
     if not d.is_dir():
         return []
@@ -76,27 +121,33 @@ def _scan(directory: str, prefix: str) -> List[Tuple[int, str]]:
     for child in d.iterdir():
         if child.name.startswith(prefix) and not _is_unfinalized(child.name):
             step = parse_step_from_name(child.name)
-            if step is not None:
-                out.append((step, os.fspath(child)))
+            if step is None:
+                continue
+            if finalized_only and not _looks_finalized(child):
+                continue
+            out.append((step, os.fspath(child)))
     return sorted(out)
 
 
 def find_resume_checkpoint(directory: str) -> Optional[str]:
-    """Newest ``model_*`` checkpoint in the run dir (reference
-    ``find_resume_checkpoint`` trainer.py:329-335 scans the logger dir)."""
-    found = _scan(directory, "model_")
+    """Newest FINALIZED ``model_*`` checkpoint in the run dir (reference
+    ``find_resume_checkpoint`` trainer.py:329-335 scans the logger dir).
+    Torn saves — orbax tmp dirs AND final-named dirs without the commit
+    marker — are skipped, so a crash mid-save resumes from the previous
+    step instead of dying on an unrestorable directory."""
+    found = _scan(directory, "model_", finalized_only=True)
     return found[-1][1] if found else None
 
 
 def resume_target(directory: str,
                   explicit_model_path: str = "") -> Tuple[int, str]:
-    """``(step, model_path)`` a run over ``directory`` will resume from —
-    ``(0, "")`` when fresh. The ONE discovery rule (explicit path wins,
-    else newest ``model_*``, step parsed from the name). run/train.py
-    resolves this ONCE and hands the path to TrainLoop as the explicit
-    resume target, so the data-stream fast-forward and the restored state
-    cannot desync even if another checkpoint lands mid-setup (exact-order
-    resume)."""
+    """``(step, model_path)`` a run over ``directory`` would resume from —
+    ``(0, "")`` when fresh. The discovery rule (explicit path wins, else
+    newest finalized ``model_*``, step parsed from the name). NOTE: this
+    is a PREVIEW — :func:`restore_resume_state` may walk back further if
+    the newest checkpoint fails to restore, which is why run/train.py now
+    wires the data fast-forward from the step the loop ACTUALLY restored
+    (``TrainLoop.set_data``), not from this function."""
     path = explicit_model_path or find_resume_checkpoint(directory)
     if not path:
         return 0, ""
@@ -146,7 +197,7 @@ def find_opt_checkpoint(directory: str, step: int) -> Optional[str]:
 
 
 def latest_step(directory: str) -> int:
-    found = _scan(directory, "model_")
+    found = _scan(directory, "model_", finalized_only=True)
     return found[-1][0] if found else 0
 
 
@@ -166,6 +217,7 @@ class AsyncSaver:
 
     def __init__(self) -> None:
         self._ckptrs: List[ocp.Checkpointer] = []
+        self._inflight_keys: List[Tuple[str, int]] = []
 
     def wait(self) -> None:
         """Block until every in-flight save is durable."""
@@ -173,6 +225,11 @@ class AsyncSaver:
             c.wait_until_finished()
             c.close()
         self._ckptrs = []
+        # Only now — durable — does the step leave the in-flight registry
+        # and become fair game for retention pruning.
+        for key in self._inflight_keys:
+            _IN_FLIGHT.discard(key)
+        self._inflight_keys = []
 
     def save(self, directory: str, step: int, params: Any,
              ema: Optional[Dict[str, Any]] = None,
@@ -201,6 +258,14 @@ class AsyncSaver:
                   for rate, tree in (ema or {}).items()]
         if opt_state is not None:
             trees.append((d / f"opt_{step:06d}", opt_state))
+        # Register BEFORE scheduling: from the first array write until
+        # wait() observes durability, this step is invisible to (and
+        # undeletable by) prune_checkpoints — the model_ tree can finalize
+        # while its ema_/opt_ companions are still writing, and an
+        # in-place backend's dirs carry their final names the whole time.
+        key = (_norm_dir(os.fspath(d)), step)
+        _IN_FLIGHT.add(key)
+        self._inflight_keys.append(key)
         for path, tree in trees:
             ckptr = _checkpointer()
             ckptr.save(path, tree, force=True)
@@ -232,15 +297,27 @@ def prune_checkpoints(directory: str, keep: int) -> List[int]:
     if not d.is_dir():
         return []
     # ONE directory listing serves both the step ranking and the deletes —
-    # each listing is a remote LIST on gs:// run dirs. Unfinalized Orbax
-    # tmp dirs are excluded from BOTH: they must never rank as checkpoints
-    # nor be deleted (one may be a save in flight).
+    # each listing is a remote LIST on gs:// run dirs. Unfinalized
+    # checkpoints are excluded from BOTH ranking and deletion: orbax tmp
+    # dirs, final-named dirs without the commit marker (an in-place write
+    # mid-flight or a torn crash), and any step the AsyncSaver registry
+    # says this process is still writing — a save must become durable
+    # before retention may count it, let alone delete it.
+    inflight = in_flight_steps(directory)
     children = [(child, child.name) for child in d.iterdir()
                 if not _is_unfinalized(child.name)]
-    steps = sorted(parse_step_from_name(n) for _, n in children
-                   if n.startswith("model_")
-                   and parse_step_from_name(n) is not None)
-    doomed = set(steps[:-keep] if len(steps) > keep else [])
+    protected = set(inflight)
+    steps = []
+    for child, n in children:
+        step = parse_step_from_name(n)
+        if not n.startswith("model_") or step is None:
+            continue
+        if step in inflight or not _looks_finalized(child):
+            protected.add(step)
+        else:
+            steps.append(step)
+    steps = sorted(steps)
+    doomed = set(steps[:-keep] if len(steps) > keep else []) - protected
     if not doomed:
         return []
     # A step counts as pruned only when EVERY one of its dirs (model_ +
@@ -301,35 +378,78 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
         # fresh init (a typo'd path, or a reference-style model_NNNNNN.pt
         # FILE where an Orbax checkpoint DIRECTORY is expected, would
         # otherwise restart training from scratch unnoticed; the reference
-        # asserts on malformed names, trainer.py:319-327).
+        # asserts on malformed names, trainer.py:319-327). It also never
+        # walks back: the user asked for THIS checkpoint, so a failure to
+        # restore it is their error to see, not ours to paper over.
         if not epath.Path(explicit_model_path).is_dir():
             raise FileNotFoundError(
                 f"resume_checkpoint={explicit_model_path!r} is not an Orbax "
                 f"checkpoint directory (expected .../model_{{step:06d}}/)")
-        model_path = explicit_model_path
+        candidates = [explicit_model_path]
     else:
-        model_path = find_resume_checkpoint(directory)
-        if not model_path:
+        # Newest first; older finalized checkpoints are the walk-back
+        # ladder. Before this, one corrupt newest checkpoint (bit rot, a
+        # partially-synced copy, an injected chaos fault) made EVERY
+        # restart attempt die in restore forever — the elastic launcher
+        # would burn its whole restart budget on an unrestorable file.
+        found = _scan(directory, "model_", finalized_only=True)
+        candidates = [p for _, p in reversed(found)]
+        if not candidates:
             return None
-    # Parse the step from the path actually being restored (never re-scan:
-    # a checkpoint finalized between two scans would desync step and params).
-    step = parse_step_from_name(model_path) or 0
-    params = restore_checkpoint(model_path, abstract_params)
+    last_err: Optional[Exception] = None
+    for model_path in candidates:
+        # Parse the step from the path actually being restored (never
+        # re-scan: a checkpoint finalized between two scans would desync
+        # step and params).
+        step = parse_step_from_name(model_path) or 0
+        try:
+            params = restore_checkpoint(model_path, abstract_params)
+        except Exception as e:  # orbax surfaces corruption as
+            # ValueError/FileNotFoundError/tensorstore errors — any of
+            # them means "this checkpoint cannot feed a resume"
+            if explicit_model_path:
+                raise
+            logger.warn(
+                f"resume: restoring {model_path} failed "
+                f"({type(e).__name__}: {str(e)[:200]}); walking back to "
+                f"the next older checkpoint")
+            last_err = e
+            continue
+        break
+    else:
+        # Every discovered checkpoint failed to restore. Fail LOUDLY: a
+        # silent fresh start from step 0 in a dir full of checkpoints is
+        # the worst outcome (it would overwrite the run's history), and
+        # the launcher's crash-loop fail-fast stops the restart burn.
+        raise RuntimeError(
+            f"resume: all {len(candidates)} checkpoint(s) in {directory} "
+            f"failed to restore; newest error: {last_err}") from last_err
     out: Dict[str, Any] = {"step": step, "params": params, "ema": {},
-                           "opt_state": None}
+                           "opt_state": None, "path": model_path}
     directory = os.fspath(epath.Path(model_path).parent)
+
+    def _degraded(rate: str) -> Any:
+        # Missing/unrestorable companion degrades to a COPY of params
+        # (reference seeds EMA from params, trainer.py:110-113) — never an
+        # alias, which would be donated twice by the jitted step and crash.
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(jnp.copy, params)
+
     for rate in ema_rates:
         p = find_ema_checkpoint(directory, step, rate)
-        if p:
-            out["ema"][rate] = restore_checkpoint(p, abstract_params)
-        else:
-            # Missing companion degrades to a COPY of params (reference seeds
-            # EMA from params, trainer.py:110-113) — never an alias, which
-            # would be donated twice by the jitted step and crash.
-            import jax.numpy as jnp
-            out["ema"][rate] = jax.tree_util.tree_map(jnp.copy, params)
+        try:
+            out["ema"][rate] = (restore_checkpoint(p, abstract_params)
+                                if p else _degraded(rate))
+        except Exception as e:  # corrupt companion: degrade like missing
+            logger.warn(f"resume: EMA companion {p} failed to restore "
+                        f"({type(e).__name__}); seeding from params")
+            out["ema"][rate] = _degraded(rate)
     if abstract_opt is not None:
         p = find_opt_checkpoint(directory, step)
         if p:
-            out["opt_state"] = restore_checkpoint(p, abstract_opt)
+            try:
+                out["opt_state"] = restore_checkpoint(p, abstract_opt)
+            except Exception as e:  # fresh optimizer beats a dead resume
+                logger.warn(f"resume: optimizer companion {p} failed to "
+                            f"restore ({type(e).__name__}); reinitializing")
     return out

@@ -56,8 +56,8 @@ from ..parallel.sharding import (
 )
 from . import checkpoint as ckpt_lib
 from . import logger
-from .perf import AOTStep, RecompileMonitor, StallBreakdown, StepTimer, \
-    device_peak_flops, mfu, transformer_train_flops_per_token
+from .perf import AOTStep, GoodputTracker, RecompileMonitor, StallBreakdown, \
+    StepTimer, device_peak_flops, mfu, transformer_train_flops_per_token
 
 __all__ = ["TrainLoop", "TrainState", "update_ema"]
 
@@ -120,6 +120,9 @@ class TrainLoop:
         sanitize: bool = False,
         prefetch_depth: int = 0,
         dispatch_lag: int = 0,
+        chaos: Optional[Any] = None,
+        progress_file: str = "",
+        recompute_until_step: int = 0,
     ) -> None:
         # Time-to-signal accounting starts at construction: everything up
         # to the end of the first optimizer step (state init, restore,
@@ -153,6 +156,20 @@ class TrainLoop:
         self.keep_checkpoints = keep_checkpoints
         self._saver = ckpt_lib.AsyncSaver()
         self.checkpoint_dir = checkpoint_dir or logger.get_dir() or ""
+        # Run-dir handshake: the launcher cannot re-derive the run dir a
+        # wrapped script resolved, so workers stamp it into the file the
+        # launcher names — that is where attempts.jsonl and the progress
+        # beacons live. Every rank writes (identical content, last wins):
+        # the rank-0 worker may be the one the chaos plan just killed.
+        run_dir_file = os.environ.get("DPT_RUN_DIR_FILE", "")
+        if run_dir_file and self.checkpoint_dir:
+            d = (self.checkpoint_dir if "://" in self.checkpoint_dir
+                 else os.path.abspath(self.checkpoint_dir))
+            try:
+                with open(run_dir_file, "w") as f:
+                    f.write(d)
+            except OSError:
+                pass  # supervision telemetry must never fail training
         # SURVEY.md §5.1 rebuild note: a first-class jax.profiler trace hook.
         # A short window a few steps in (past compilation) is captured into
         # profile_dir in TensorBoard format; 0-length dir disables.
@@ -173,6 +190,31 @@ class TrainLoop:
         self.stalls = StallBreakdown()
         # (loop step idx, dispatch-return timestamp, device metrics tree)
         self._inflight: "collections.deque" = collections.deque()
+
+        # Chaos harness + goodput accounting (ISSUE 8). ``chaos`` is a
+        # ChaosInjector (or None): three hook points — top of run_step,
+        # before each batch pull, right after a save is scheduled — let a
+        # ChaosPlan kill/stall/corrupt this process at an exact step.
+        # ``progress_file`` (set by run/train.py under the launcher) is a
+        # per-step beacon: current step + in-attempt goodput snapshot,
+        # atomically replaced each step — a SIGKILLed attempt's flight
+        # recorder, and how the launcher measures step progress for its
+        # crash-loop fail-fast. ``recompute_until_step`` marks steps an
+        # earlier attempt already paid for (the last-checkpoint..crash
+        # window): their wall time books as recompute, not useful.
+        self.chaos = chaos
+        self.progress_file = progress_file
+        self.recompute_until_step = recompute_until_step
+        self.goodput = GoodputTracker(t0=self._construct_t0)
+        spawn_t = os.environ.get("DPT_SPAWN_T", "")
+        if spawn_t:
+            # The launcher stamps each worker's spawn wall-clock: the
+            # interpreter+jax+distributed-init span before this
+            # constructor ran is real attempt time, booked as startup.
+            startup = max(0.0, time.time() - float(spawn_t))
+            self.goodput.base_s = startup
+            self.goodput.add("startup_s", startup)
+        self._recompiles_at_first_step: Optional[int] = None
 
         # Runtime sanitizer (the dynamic half of analysis/ graftlint):
         # count every XLA compile into the recompile_count gauge, and run
@@ -200,6 +242,16 @@ class TrainLoop:
     def _finish_init(self, mesh, batch_size: int, seed: int,
                      resume_checkpoint: str) -> None:
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh()
+        # Under the launcher (DPT_ATTEMPT set) every TrainLoop emits the
+        # per-step progress beacon by default: supervision — crash-loop
+        # detection, step-progress records, post-mortem goodput — works
+        # for ANY wrapped script, not just run/train.py.
+        if (not self.progress_file and self.checkpoint_dir
+                and "://" not in self.checkpoint_dir
+                and os.environ.get("DPT_ATTEMPT") is not None):
+            from ..chaos.goodput import beacon_path
+            self.progress_file = beacon_path(self.checkpoint_dir,
+                                             jax.process_index())
         # global batch = per-host batch x hosts (reference trainer.py:89)
         self.global_batch = batch_size * jax.process_count()
         dpf = (self.mesh.shape["data"] * self.mesh.shape["fsdp"]
@@ -227,9 +279,7 @@ class TrainLoop:
         # happen, never WHICH indices the underlying iterator draws, so
         # skip_batches exact-resume is untouched.
         if self.prefetch_depth > 0 and self.data is not None:
-            self.data = prefetch_to_device(
-                self.data, put=self._prepare, depth=self.prefetch_depth,
-                length_of=self.get_batch_length, stats=self.stalls)
+            self.data = self._wrap_prefetch(self.data)
 
         # Cumulative sample count via the get_batch_length hook; seeded from
         # the resumed step so the gauge is continuous across restarts.
@@ -240,6 +290,44 @@ class TrainLoop:
         self._flops_per_token = transformer_train_flops_per_token(
             self.n_params, self.workload.num_layers,
             self.workload.hidden_size, self.workload.seq_len)
+        # Goodput step-slice anchors: wall time between consecutive
+        # run_step completions is one step's slice; compile/data-stall
+        # deltas within a slice are already booked to their own
+        # categories, so recompute attribution subtracts them.
+        # Construction minus the restore share is setup: state init and
+        # trace-time work a restart pays even warm. Booking it keeps the
+        # useful residual to actual step-loop time.
+        self.goodput.add("setup_s",
+                         (time.perf_counter() - self._construct_t0)
+                         - self.goodput.get("restore_s"))
+        self._g_prev_t = time.perf_counter()
+        self._g_prev_stall = self._stall_sum()
+        self._g_prev_compile = self.goodput.get("compile_s")
+
+    def _wrap_prefetch(self, data: Iterator) -> Iterator[DeviceBatch]:
+        return prefetch_to_device(
+            data, put=self._prepare, depth=self.prefetch_depth,
+            length_of=self.get_batch_length, stats=self.stalls)
+
+    def set_data(self, data: Iterator, *, eval_data: Optional[Iterator] = None,
+                 eval_batches_consumed: Optional[int] = None) -> None:
+        """Late data wiring: iterators created AFTER construction, so their
+        resume fast-forward can use the step this loop ACTUALLY restored —
+        which may be older than the newest checkpoint when the restore
+        walked back past a corrupt one (run/train.py builds the loop
+        first, reads ``loop.step``, then skips exactly that many batches).
+        Applies the same prefetch wrapping the constructor would."""
+        self.data = (self._wrap_prefetch(data)
+                     if self.prefetch_depth > 0 and data is not None
+                     else data)
+        if eval_data is not None:
+            self.eval_data = eval_data
+        if eval_batches_consumed is not None:
+            self.eval_batches_consumed = eval_batches_consumed
+
+    def _stall_sum(self) -> float:
+        s = self.stalls.sums()
+        return s["data_wait_s"] + s["h2d_wait_s"]
 
     # ------------------------------------------------------------ state setup
 
@@ -308,6 +396,7 @@ class TrainLoop:
         # checkpoint net): Orbax restores into the requested shardings via
         # explicit placement, so an implicit transfer here means resume
         # code regressed into a host round-trip.
+        t_restore0 = time.perf_counter()
         with self._sanitize_guard():
             restored = ckpt_lib.restore_resume_state(
                 self.checkpoint_dir,
@@ -316,8 +405,10 @@ class TrainLoop:
                 abstract_opt=_abstract_like(opt_state),
                 explicit_model_path=resume_checkpoint,
             )
+        self.resumed_from = ""
         if restored is not None:
             self.step = restored["step"]
+            self.resumed_from = restored.get("path", "")
             # One-time defensive copy: the jitted train step DONATES the
             # whole TrainState, and donating orbax-restored buffers directly
             # is unsafe when the executable came from the persistent
@@ -339,7 +430,13 @@ class TrainLoop:
                 del opt_state
                 opt_state = own(restored.pop("opt_state"))
             logger.info(f"resumed from step {self.step} "
-                        f"({self.checkpoint_dir or resume_checkpoint})")
+                        f"({self.resumed_from or self.checkpoint_dir})")
+        # Restore cost (discovery + orbax reads + walk-back + ownership
+        # copies) is goodput overhead — the number a warm resume should
+        # shrink, and the per-attempt "resume overhead" attempts.jsonl
+        # records.
+        self.goodput.add("restore_s", time.perf_counter() - t_restore0)
+        self._resume_step = self.step
 
         self.state = TrainState(
             step=jax.device_put(jnp.asarray(self.step, jnp.int32),
@@ -476,6 +573,7 @@ class TrainLoop:
         """AOTStep callback: accumulate and log compile time (summed across
         step functions and recompiles within a log window)."""
         self.compile_time_s = (self.compile_time_s or 0.0) + seconds
+        self.goodput.add("compile_s", seconds)
         logger.logkv_sum("compile_time_s", round(seconds, 3))
         logger.info(f"compiled {name} in {seconds:.2f}s")
 
@@ -523,6 +621,13 @@ class TrainLoop:
         the ``data_wait_s`` stall gauge. With device prefetch on, the
         wrapper attributes its own waits internally (this call returns a
         buffered :class:`DeviceBatch` without double counting)."""
+        if self.chaos is not None:
+            # An injected iterator stall is exactly the failure the
+            # data_wait gauge measures — attribute it there so the stall
+            # lands in the goodput breakdown as input-pipeline time.
+            stalled = self.chaos.on_data(self)
+            if stalled:
+                self.stalls.add("data_wait_s", stalled)
         if self.prefetch_depth > 0:
             return next(self.data)
         t0 = time.perf_counter()
@@ -541,6 +646,11 @@ class TrainLoop:
         device scalars, but logging them is deferred: step N-k's metrics
         are fetched/logged while step N runs, so the host never blocks on
         the step it just enqueued (flush_metrics drains the tail)."""
+        if self.chaos is not None:
+            # Kill/corrupt faults scheduled for the step about to run —
+            # self.step is the count of COMPLETED steps, so a fault at
+            # step k fires after k steps finished, before step k+1.
+            self.chaos.on_step(self)
         first = self.time_to_first_step_s is None
         if isinstance(batch, DeviceBatch):
             prepared = batch.arrays
@@ -563,9 +673,30 @@ class TrainLoop:
                                          - self._construct_t0)
             logger.logkv("time_to_first_step_s",
                          round(self.time_to_first_step_s, 3))
+            # Steady-state recompile baseline: compiles after this point
+            # are silent retraces — the gauge that must stay frozen on a
+            # warm-cache resume (the chaos bench acceptance).
+            self._recompiles_at_first_step = self._recompiles.count
         self.step += 1
         self._samples += n_items * jax.process_count()
         self._timer.tick()
+        # Goodput step-slice attribution: the wall span since the previous
+        # run_step completed is this step's slice. For steps an earlier
+        # attempt already reached (<= recompute_until_step), the slice —
+        # minus whatever compile/data-stall time inside it was already
+        # booked to its own category — is recompute: real work, but work
+        # the run has paid for once before.
+        now = time.perf_counter()
+        if self.step <= self.recompute_until_step:
+            booked = ((self.goodput.get("compile_s") - self._g_prev_compile)
+                      + (self._stall_sum() - self._g_prev_stall))
+            self.goodput.add(
+                "recompute_s", max(0.0, (now - self._g_prev_t) - booked))
+        self._g_prev_t = now
+        self._g_prev_stall = self._stall_sum()
+        self._g_prev_compile = self.goodput.get("compile_s")
+        if self.progress_file:
+            self._write_beacon()
         if self.dispatch_lag > 0:
             self._inflight.append((self.step, dispatched, metrics))
             while len(self._inflight) > self.dispatch_lag:
@@ -621,6 +752,81 @@ class TrainLoop:
         if self.sanitize:
             logger.logkv("recompile_count", self.recompile_count)
 
+    # ------------------------------------------------------ goodput/beacon
+
+    @property
+    def steady_recompile_count(self) -> int:
+        """XLA compiles observed AFTER the first completed step (sanitize
+        mode): the warm-path gauge — a resumed attempt under a warm
+        persistent cache must hold this at 0 even though its construction
+        legitimately compiled (restore copies are new programs on a first
+        resume)."""
+        if self._recompiles_at_first_step is None:
+            return 0
+        return self._recompiles.count - self._recompiles_at_first_step
+
+    def goodput_summary(self) -> Dict[str, float]:
+        """Point-in-time goodput decomposition for this attempt: wall
+        (spawn→now when the launcher stamped DPT_SPAWN_T, else
+        construction→now) split into useful / startup / restore / compile
+        / save / data-stall / recompute."""
+        return self.goodput.summary(extra={"data_stall_s": self._stall_sum()})
+
+    def _write_beacon(self) -> None:
+        """Atomically replace the per-step progress beacon: step, wall
+        clock, and the goodput snapshot. A killed attempt's last beacon is
+        its flight recorder (the launcher snapshots it into
+        attempts.jsonl); the step field doubles as the launcher's
+        crash-loop progress probe and the next attempt's
+        recompute-boundary."""
+        payload = {
+            "step": self.step,
+            # the step THIS attempt restored from: progress must be judged
+            # against it, not the run's high-water mark — an attempt that
+            # walked back past a corrupt checkpoint makes real progress
+            # below the old maximum
+            "start_step": self._resume_step,
+            "t": time.time(),
+            "attempt": int(os.environ.get("DPT_ATTEMPT") or 0),
+            "rank": jax.process_index(),
+            "recompile_count": self.recompile_count,
+            "steady_recompile_count": self.steady_recompile_count,
+            "goodput": {k: round(v, 6)
+                        for k, v in self.goodput_summary().items()},
+        }
+        tmp = self.progress_file + ".tmp"
+        try:
+            import json as _json
+            with open(tmp, "w") as f:
+                f.write(_json.dumps(payload))
+            os.replace(tmp, self.progress_file)
+        except OSError as e:  # beacon is telemetry: never fail a step
+            logger.warn(f"progress beacon write failed: {e}")
+
+    def _write_goodput_record(self) -> None:
+        """Rank 0, at loop exit: the attempt's final goodput record
+        (``goodput_attempt{A:03d}.json`` next to the checkpoints). The
+        clean-exit counterpart of the beacon — aggregate_run prefers it."""
+        if not self.checkpoint_dir or jax.process_index() != 0:
+            return
+        attempt = int(os.environ.get("DPT_ATTEMPT") or 0)
+        payload = {
+            "attempt": attempt,
+            "steps": [self._resume_step, self.step],
+            "recompile_count": self.recompile_count,
+            "steady_recompile_count": self.steady_recompile_count,
+            "compile_time_s": self.compile_time_s or 0.0,
+            **{k: round(v, 6) for k, v in self.goodput_summary().items()},
+        }
+        try:
+            import json as _json
+            path = os.path.join(self.checkpoint_dir,
+                                f"goodput_attempt{attempt:03d}.json")
+            with open(path, "w") as f:
+                f.write(_json.dumps(payload))
+        except OSError as e:
+            logger.warn(f"goodput record write failed: {e}")
+
     def _log_throughput(self) -> None:
         sps, tps = self._timer.lap()
         if tps > 0:
@@ -634,6 +840,10 @@ class TrainLoop:
         # the bottleneck" as a number in every sink.
         for gauge, mean_s in self.stalls.lap().items():
             logger.logkv(gauge, round(mean_s, 6))
+        # Cumulative goodput ratio (useful-step share of the attempt's
+        # wall so far) rides the same cadence: a run bleeding time to
+        # restarts/stalls shows it here long before the bench does.
+        logger.logkv("goodput", round(self.goodput_summary()["goodput"], 4))
 
     def _maybe_profile(self, loop_step: int) -> None:
         """Start/stop the jax.profiler trace window (steps counted from loop
@@ -711,6 +921,13 @@ class TrainLoop:
             self.save(wait=False)
         self.wait_for_saves()  # exit barrier: the last write must be durable
         self._prune()  # final retention pass over the finalized set
+        # The attempt's goodput decomposition, durable next to the
+        # checkpoints (and in the logs): the clean-exit record
+        # aggregate_run folds with the launcher's attempts.jsonl.
+        summary = self.goodput_summary()
+        logger.logkvs({f"goodput_{k}" if k != "goodput" else k:
+                       round(v, 4) for k, v in summary.items()})
+        self._write_goodput_record()
 
     __call__ = run_loop  # reference trainer.py:357
 
@@ -735,11 +952,19 @@ class TrainLoop:
         # scheduling: Orbax's device->host fetch is explicit (and proven
         # guard-clean by test), so anything that trips here is an
         # accidental implicit transfer sneaking into the save path.
+        t_save0 = time.perf_counter()
         with self._sanitize_guard():
             self._saver.save(
                 self.checkpoint_dir, self.step, self.state.params,
                 ema={r: self.state.ema[r] for r in self.ema_rates},
                 opt_state=self.state.opt_state, wait=wait)
+        self.goodput.add("save_s", time.perf_counter() - t_save0)
+        if self.chaos is not None:
+            # crash_in_save faults fire HERE: the async array write is in
+            # flight (or, with wait=True, just finalized), so a SIGKILL
+            # lands between write and finalize — the torn-checkpoint case
+            # the resume path must survive.
+            self.chaos.on_save(self)
         ckpt_lib.save_meta(self.checkpoint_dir, self.step, {
             "eval_batches_consumed": self.eval_batches_consumed,
             "eval_interval": self.eval_interval,
@@ -765,4 +990,6 @@ class TrainLoop:
 
     def wait_for_saves(self) -> None:
         """Barrier on the in-flight async checkpoint saves, if any."""
+        t0 = time.perf_counter()
         self._saver.wait()
+        self.goodput.add("save_s", time.perf_counter() - t0)

@@ -1,0 +1,50 @@
+"""Fixture worker for launcher restart-supervision tests (no jax import —
+these tests exercise the SUPERVISOR, so the worker is a stub that plays a
+TrainLoop's part: it stamps the run dir into DPT_RUN_DIR_FILE, advances a
+progress beacon, and exits with a scripted code per attempt).
+
+Argv: --dir RUNDIR --fail_times N [--steps_per_attempt K] [--no_beacon]
+
+Attempt index arrives via DPT_ATTEMPT (set by the launcher). Exits 1 while
+attempt < fail_times, else 0. With --steps_per_attempt 0 the beacon still
+reports the previous max (zero progress — the crash-loop case); with
+--no_beacon it writes none at all (a non-TrainLoop script — progress
+unknown)."""
+
+import argparse
+import json
+import os
+import time
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--dir", required=True)
+parser.add_argument("--fail_times", type=int, default=0)
+parser.add_argument("--steps_per_attempt", type=int, default=5)
+parser.add_argument("--no_beacon", action="store_true")
+ns = parser.parse_args()
+
+attempt = int(os.environ.get("DPT_ATTEMPT") or 0)
+os.makedirs(ns.dir, exist_ok=True)
+
+run_dir_file = os.environ.get("DPT_RUN_DIR_FILE")
+if run_dir_file:
+    with open(run_dir_file, "w") as f:
+        f.write(os.path.abspath(ns.dir))
+
+if not ns.no_beacon:
+    spawn_t = float(os.environ.get("DPT_SPAWN_T") or time.time())
+    step = (attempt + 1) * ns.steps_per_attempt
+    payload = {
+        "step": step, "t": time.time(), "attempt": attempt, "rank": 0,
+        "recompile_count": 0, "steady_recompile_count": 0,
+        "goodput": {"wall_s": time.time() - spawn_t + 0.5,
+                    "useful_step_s": 0.4, "goodput": 0.8,
+                    "startup_s": max(0.0, time.time() - spawn_t),
+                    "setup_s": 0.05, "restore_s": 0.02, "compile_s": 0.03,
+                    "save_s": 0.0, "data_stall_s": 0.0, "recompute_s": 0.0},
+    }
+    with open(os.path.join(ns.dir, ".progress_rank0.json"), "w") as f:
+        f.write(json.dumps(payload))
+
+print(f"CHAOSCHILD attempt={attempt}", flush=True)
+raise SystemExit(1 if attempt < ns.fail_times else 0)

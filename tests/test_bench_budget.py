@@ -86,8 +86,9 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert skipped, "1s budget must skip every non-headline leg"
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
-    # (headline + prefetch A/B twin + noaccum + moe8 + moe8-cf1 + scan)
-    assert len(final["configs"]) == 6
+    # (headline + prefetch A/B twin + chaos + noaccum + moe8 + moe8-cf1
+    # + scan)
+    assert len(final["configs"]) == 7
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
@@ -204,7 +205,7 @@ def test_launcher_forwards_cache_env_to_ring(monkeypatch, tmp_path):
 
     def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
                   run_timestamp=None, log_dir="", log_tee=False,
-                  cache_dir=""):
+                  cache_dir="", **kw):
         seen["cache_dir"] = cache_dir
         return 0
 

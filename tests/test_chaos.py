@@ -1,0 +1,591 @@
+"""Chaos harness + goodput accounting (ISSUE 8).
+
+Fast tier: plan parsing, fault execution (kill monkeypatched, stall/corrupt
+real), checkpoint-restore walk-back over corrupt/mismatched checkpoints,
+prune vs in-flight saves, GoodputTracker arithmetic, TrainLoop goodput
+artifacts, and launcher restart supervision (backoff, sliding-window
+budget, crash-loop fail-fast, attempts.jsonl) driven through REAL spawned
+worker processes that never import jax (tests/_chaos_child.py).
+
+Slow tier: the end-to-end ring — run/train.py under the launcher with an
+injected SIGKILL plus a corrupted newest checkpoint must walk back, resume
+in the SAME auto-generated run dir (the DPT_RUN_TIMESTAMP pinning
+contract), reach the target step with parameters BIT-IDENTICAL to an
+uninterrupted run, and account for every second of wall time.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.chaos import (
+    ChaosInjector,
+    ChaosPlan,
+    aggregate_run,
+    corrupt_newest_checkpoint,
+    read_attempts,
+)
+from distributed_pipeline_tpu.data import load_data_from_args
+from distributed_pipeline_tpu.models import create_model_from_config
+from distributed_pipeline_tpu.parallel import launcher, make_mesh
+from distributed_pipeline_tpu.utils import checkpoint as ckpt
+from distributed_pipeline_tpu.utils import logger
+from distributed_pipeline_tpu.utils.perf import GoodputTracker
+from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- ChaosPlan
+
+def test_chaos_plan_parses_inline_json_and_file(tmp_path):
+    src = ('{"faults": [{"kind": "kill", "step": 3, "rank": 1, '
+           '"sig": "SIGTERM"}, {"kind": "stall_data", "step": 2, '
+           '"seconds": 0.5}]}')
+    plan = ChaosPlan.parse(src)
+    assert len(plan.faults) == 2
+    assert plan.faults[0].sig == "SIGTERM" and plan.faults[0].rank == 1
+    assert "kill@step3/rank1" in plan.describe()
+    # @file and bare-path forms
+    p = tmp_path / "plan.json"
+    p.write_text(src)
+    assert ChaosPlan.parse(f"@{p}") == plan
+    assert ChaosPlan.parse(str(p)) == plan
+    # roundtrip through to_json (the env-channel form)
+    assert ChaosPlan.parse(plan.to_json()) == plan
+
+
+def test_chaos_plan_rejects_malformed():
+    with pytest.raises(ValueError, match="kind"):
+        ChaosPlan.parse('{"faults": [{"kind": "meteor", "step": 1}]}')
+    with pytest.raises(ValueError, match="non-empty"):
+        ChaosPlan.parse('{"faults": []}')
+    with pytest.raises(ValueError, match="JSON"):
+        ChaosPlan.parse("not json at all")
+    with pytest.raises(ValueError, match="unknown keys"):
+        ChaosPlan.parse('{"faults": [{"kind": "kill", "step": 1, "pid": 9}]}')
+
+
+# ----------------------------------------------------- checkpoint hardening
+
+def _save(d, step, tree):
+    ckpt.save_checkpoint(str(d), step, tree)
+
+
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+def test_corrupt_newest_checkpoint_targets_newest_finalized(tmp_path):
+    tree = {"a": jnp.arange(8.0)}
+    _save(tmp_path, 1, tree)
+    _save(tmp_path, 2, tree)
+    victim = corrupt_newest_checkpoint(str(tmp_path))
+    assert victim.endswith("model_000002")
+    # the commit marker survives — the dir still LOOKS finalized (that is
+    # the point: restore must fail and walk back, not discovery skip it)
+    assert os.path.exists(os.path.join(victim, "_CHECKPOINT_METADATA"))
+
+
+def test_restore_walks_back_past_corrupt_newest(tmp_path):
+    tree = {"a": jnp.arange(8.0)}
+    _save(tmp_path, 1, tree)
+    _save(tmp_path, 2, jax.tree_util.tree_map(lambda x: x * 3, tree))
+    corrupt_newest_checkpoint(str(tmp_path))
+    out = ckpt.restore_resume_state(str(tmp_path),
+                                    abstract_params=_abstract(tree))
+    assert out["step"] == 1
+    assert out["path"].endswith("model_000001")
+    np.testing.assert_array_equal(np.asarray(out["params"]["a"]),
+                                  np.arange(8.0))
+
+
+def test_restore_walks_back_past_structure_mismatch(tmp_path):
+    """The meta/params mismatch case: the newest checkpoint restores into a
+    DIFFERENT tree structure (half-migrated run, wrong model family) — the
+    structural error walks back exactly like payload corruption."""
+    tree = {"a": jnp.arange(8.0)}
+    _save(tmp_path, 1, tree)
+    _save(tmp_path, 2, {"a": jnp.arange(8.0), "extra": jnp.ones((3,))})
+    out = ckpt.restore_resume_state(str(tmp_path),
+                                    abstract_params=_abstract(tree))
+    assert out["step"] == 1
+
+
+def test_restore_raises_when_every_checkpoint_corrupt(tmp_path):
+    """A run dir full of unrestorable checkpoints must fail LOUDLY — a
+    silent fresh start from step 0 would overwrite the run's history (and
+    the launcher's crash-loop fail-fast needs the loud death)."""
+    tree = {"a": jnp.arange(4.0)}
+    _save(tmp_path, 1, tree)
+    corrupt_newest_checkpoint(str(tmp_path))
+    with pytest.raises(RuntimeError, match="failed to restore"):
+        ckpt.restore_resume_state(str(tmp_path),
+                                  abstract_params=_abstract(tree))
+
+
+def test_explicit_resume_path_never_walks_back(tmp_path):
+    tree = {"a": jnp.arange(4.0)}
+    _save(tmp_path, 1, tree)
+    _save(tmp_path, 2, tree)
+    corrupt_newest_checkpoint(str(tmp_path))
+    with pytest.raises(Exception):
+        ckpt.restore_resume_state(
+            str(tmp_path), abstract_params=_abstract(tree),
+            explicit_model_path=str(tmp_path / "model_000002"))
+
+
+def test_find_resume_skips_torn_finalized_name(tmp_path):
+    """A model_ dir with its FINAL name but no orbax commit marker is a
+    torn save (in-place write crashed between array write and finalize):
+    discovery must resume from the previous step, and retention must not
+    rank or delete it."""
+    tree = {"a": jnp.arange(4.0)}
+    _save(tmp_path, 1, tree)
+    (tmp_path / "model_000002").mkdir()  # torn: no _CHECKPOINT_METADATA
+    found = ckpt.find_resume_checkpoint(str(tmp_path))
+    assert found.endswith("model_000001")
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    _save(tmp_path, 3, tree)
+    pruned = ckpt.prune_checkpoints(str(tmp_path), keep=1)
+    assert pruned == [1]
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "model_000002" in names  # torn dir untouched (may be in flight)
+
+
+class _StubCheckpointer:
+    """In-place writer that never finalizes (gs://-style mid-write state)."""
+
+    def __init__(self, finalize: bool):
+        self._finalize = finalize
+
+    def save(self, path, tree, force=True):
+        os.makedirs(os.fspath(path), exist_ok=True)
+        if self._finalize:
+            with open(os.path.join(os.fspath(path),
+                                   "_CHECKPOINT_METADATA"), "w") as f:
+                f.write("{}")
+
+    def wait_until_finished(self):
+        pass
+
+    def close(self):
+        pass
+
+
+@pytest.mark.parametrize("stub_finalizes", [False, True])
+def test_prune_skips_in_flight_async_save(tmp_path, monkeypatch,
+                                          stub_finalizes):
+    """ISSUE 8 satellite: prune must never delete (or rank) a checkpoint
+    the AsyncSaver is still writing. Covered twice: via the missing commit
+    marker (stub_finalizes=False — the torn/in-place case) and via the
+    in-flight registry alone (stub_finalizes=True — model tree finalized
+    while companions still stream)."""
+    d = str(tmp_path)
+    tree = {"a": jnp.arange(4.0)}
+    _save(tmp_path, 1, tree)
+    _save(tmp_path, 2, tree)
+    monkeypatch.setattr(ckpt, "_checkpointer",
+                        lambda: _StubCheckpointer(stub_finalizes))
+    saver = ckpt.AsyncSaver()
+    saver.save(d, 5, tree)  # scheduled, not durable (stub never really is)
+    assert ckpt.in_flight_steps(d) == {5}
+    try:
+        pruned = ckpt.prune_checkpoints(d, keep=1)
+        # ranking counted only finalized NON-in-flight steps {1, 2}
+        assert pruned == [1]
+        names = {p.name for p in tmp_path.iterdir()}
+        assert "model_000005" in names, "prune deleted an in-flight save"
+        assert "model_000002" in names
+    finally:
+        saver.wait()
+    assert ckpt.in_flight_steps(d) == set()
+
+
+# ------------------------------------------------------------ fault firing
+
+def tiny_loop(tmp_path, **kw):
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=1, num_heads=2, dtype="float32")
+    data = load_data_from_args("train", batch_size=8, dataset="synthetic-lm",
+                               seq_len=16, vocab_size=64, seed=0)
+    kw.setdefault("learning_steps", 3)
+    kw.setdefault("log_interval", 10 ** 9)
+    kw.setdefault("save_interval", 10 ** 9)
+    return TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                     mesh=make_mesh(dp=8), checkpoint_dir=str(tmp_path),
+                     seed=0, **kw)
+
+
+def test_injector_kill_fires_once_with_marker(tmp_path, monkeypatch):
+    plan = ChaosPlan.parse('{"faults": [{"kind": "kill", "step": 1}]}')
+    inj = ChaosInjector(plan, rank=0, run_dir=str(tmp_path))
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append(sig))
+    loop = tiny_loop(tmp_path, chaos=inj)
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_step(loop.next_batch())   # step 0->1, no fault yet
+        loop.run_step(loop.next_batch())   # fault fires at step==1
+        loop.run_step(loop.next_batch())   # marker: no re-fire
+    assert kills == [signal.SIGKILL]
+    assert os.path.exists(tmp_path / ".chaos_fired_00")
+    # a FRESH injector in the same run dir (= the respawned attempt) must
+    # see the marker and sail past the fault step
+    inj2 = ChaosInjector(plan, rank=0, run_dir=str(tmp_path))
+    loop2 = tiny_loop(tmp_path, chaos=inj2)
+    with logger.scoped_configure(format_strs=[]):
+        loop2.run_step(loop2.next_batch())
+        loop2.run_step(loop2.next_batch())
+    assert kills == [signal.SIGKILL]
+
+
+def test_injector_rank_gating(tmp_path, monkeypatch):
+    plan = ChaosPlan.parse('{"faults": [{"kind": "kill", "step": 0, '
+                           '"rank": 1}]}')
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append(sig))
+    loop = tiny_loop(tmp_path,
+                     chaos=ChaosInjector(plan, rank=0,
+                                         run_dir=str(tmp_path)))
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_step(loop.next_batch())
+    assert kills == []  # fault targets rank 1; this is rank 0
+
+
+def test_injector_stall_lands_in_data_wait_gauge(tmp_path):
+    plan = ChaosPlan.parse('{"faults": [{"kind": "stall_data", "step": 1, '
+                           '"seconds": 0.3}]}')
+    loop = tiny_loop(tmp_path,
+                     chaos=ChaosInjector(plan, rank=0,
+                                         run_dir=str(tmp_path)))
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_step(loop.next_batch())
+        before = loop.stalls.sums()["data_wait_s"]
+        loop.run_step(loop.next_batch())  # stall fires pulling step 2's batch
+    after = loop.stalls.sums()["data_wait_s"]
+    assert after - before >= 0.3
+    # and the goodput decomposition books it as data stall, not useful
+    assert loop.goodput_summary()["data_stall_s"] >= 0.3
+
+
+def test_crash_in_save_leaves_torn_checkpoint_that_resume_skips(
+        tmp_path, monkeypatch):
+    """on_save fires between the async array write and finalize; a real
+    SIGKILL there leaves orbax tmp dirs. Here the kill is simulated by
+    dropping the saver mid-flight and the torn state by the stub's
+    unfinalized dirs — then a fresh loop must resume from the PREVIOUS
+    step."""
+    loop = tiny_loop(tmp_path, save_interval=10 ** 9)
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_step(loop.next_batch())
+        loop.save()                      # step 1, durable
+        loop.run_step(loop.next_batch())
+        # step 2's save: schedule through a stub that never finalizes —
+        # the on-disk state a SIGKILL between write and finalize leaves
+        monkeypatch.setattr(ckpt, "_checkpointer",
+                            lambda: _StubCheckpointer(False))
+        loop._saver = ckpt.AsyncSaver()
+        loop.save(wait=False)
+    monkeypatch.undo()
+    ckpt._IN_FLIGHT.clear()  # the "killed" process's registry dies with it
+    loop2 = tiny_loop(tmp_path)
+    assert loop2.step == 1, "resume picked the torn step-2 save"
+
+
+# ------------------------------------------------------------ goodput math
+
+def test_goodput_tracker_identity_and_base_offset():
+    t = GoodputTracker()
+    t.add("restore_s", 0.01)
+    t.add("compile_s", 0.02)
+    t.base_s = 0.5  # startup measured on an earlier clock
+    t.add("startup_s", 0.5)
+    s = t.summary(extra={"data_stall_s": 0.005})
+    overhead = sum(s[c] for c in ("startup_s", "setup_s", "restore_s",
+                                  "compile_s", "save_s", "data_stall_s",
+                                  "recompute_s"))
+    assert s["wall_s"] >= 0.5
+    assert s["useful_step_s"] == pytest.approx(
+        max(0.0, s["wall_s"] - overhead))
+    assert 0.0 <= s["goodput"] <= 1.0
+    t.add("save_s", -1.0)  # negative adds are clamped, not subtracted
+    assert t.get("save_s") == 0.0
+
+
+def test_trainloop_writes_goodput_record_and_beacon(tmp_path, monkeypatch):
+    monkeypatch.setenv("DPT_ATTEMPT", "2")
+    loop = tiny_loop(tmp_path)
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_loop()
+    beacon = json.loads((tmp_path / ".progress_rank0.json").read_text())
+    assert beacon["step"] == 3 and beacon["attempt"] == 2
+    rec = json.loads((tmp_path / "goodput_attempt002.json").read_text())
+    assert rec["steps"] == [0, 3]
+    assert rec["wall_s"] >= rec["useful_step_s"] > 0
+    assert rec["compile_s"] > 0 and rec["setup_s"] > 0
+    # every second accounted: useful + categories == wall
+    cats = ("startup_s", "setup_s", "restore_s", "compile_s", "save_s",
+            "data_stall_s", "recompute_s")
+    assert rec["useful_step_s"] + sum(rec[c] for c in cats) == pytest.approx(
+        rec["wall_s"], rel=1e-3)
+    agg = aggregate_run(str(tmp_path))
+    assert agg["attempts"] == 1
+    assert agg["accounted_frac"] == pytest.approx(1.0, rel=1e-3)
+
+
+def test_recompute_attribution_on_replayed_steps(tmp_path):
+    """Steps at or below recompute_until_step (work an earlier attempt
+    already did) book their wall slice as recompute_s, not useful."""
+    loop = tiny_loop(tmp_path, learning_steps=4, recompute_until_step=2)
+    with logger.scoped_configure(format_strs=[]):
+        for _ in range(4):
+            loop.run_step(loop.next_batch())
+    s = loop.goodput_summary()
+    assert s["recompute_s"] > 0
+    assert s["recompute_s"] < s["wall_s"]
+
+
+def test_aggregate_run_folds_attempts_and_sidecars(tmp_path):
+    gp = {"wall_s": 10.0, "useful_step_s": 7.0, "goodput": 0.7,
+          "startup_s": 1.0, "setup_s": 0.5, "restore_s": 0.2,
+          "compile_s": 1.0, "save_s": 0.2, "data_stall_s": 0.1,
+          "recompute_s": 0.0}
+    # attempt 0: killed (beacon snapshot only, 2s of its duration lost)
+    a0 = {"attempt": 0, "rc": -9, "t_spawn": 100.0, "t_exit": 112.0,
+          "duration_s": 12.0, "downtime_s": 0.0, "steps": 5, "goodput": gp}
+    # attempt 1: clean exit (sidecar wins)
+    a1 = {"attempt": 1, "rc": 0, "t_spawn": 113.0, "t_exit": 124.0,
+          "duration_s": 11.0, "downtime_s": 1.0, "steps": 5,
+          "goodput": None}
+    with open(tmp_path / "attempts.jsonl", "w") as f:
+        f.write(json.dumps(a0) + "\n" + json.dumps(a1) + "\n")
+    (tmp_path / "goodput_attempt001.json").write_text(
+        json.dumps({**gp, "attempt": 1, "wall_s": 10.5,
+                    "useful_step_s": 7.5}))
+    agg = aggregate_run(str(tmp_path))
+    assert agg["attempts"] == 2
+    assert agg["useful_step_s"] == pytest.approx(14.5)
+    assert agg["wall_s"] == pytest.approx(24.0)   # 124 - 100
+    assert agg["lost_s"] == pytest.approx(2.0 + 0.5)
+    assert agg["downtime_s"] == pytest.approx(1.0)
+    assert agg["goodput"] == pytest.approx(14.5 / 24.0)
+    # every second of the run accounted for
+    assert agg["accounted_frac"] == pytest.approx(1.0, abs=0.02)
+
+
+# ------------------------------------------------- launcher supervision
+
+def test_restart_budget_sliding_window():
+    now = [1000.0]
+    b = launcher._RestartBudget(2, 100.0, now=lambda: now[0])
+    assert b.allows_restart()
+    b.charge()
+    b.charge()
+    assert not b.allows_restart()          # 2 restarts inside the window
+    now[0] += 101.0
+    assert b.allows_restart()              # both aged out of the window
+    # lifetime mode: window <= 0 never forgets
+    bl = launcher._RestartBudget(2, 0.0, now=lambda: now[0])
+    bl.charge()
+    bl.charge()
+    now[0] += 10 ** 6
+    assert not bl.allows_restart()
+
+
+def test_crash_loop_detector():
+    ok = {"rc": 0, "steps": 0}
+    dead = {"rc": 1, "steps": 0}
+    progress = {"rc": 1, "steps": 3}
+    unknown = {"rc": 1, "steps": None}
+    assert launcher._crash_looping([dead, dead])
+    assert not launcher._crash_looping([dead])
+    assert not launcher._crash_looping([progress, dead])
+    assert not launcher._crash_looping([dead, progress])
+    assert not launcher._crash_looping([unknown, unknown])
+    assert not launcher._crash_looping([ok, dead])
+
+
+def _run_chaos_child(tmp_path, *child_args, **kw):
+    return launcher.run_argv_as_distributed(
+        "tests._chaos_child",
+        ["--dir", str(tmp_path), *child_args],
+        nprocs=1, monitor_interval=0.02,
+        restart_backoff_s=kw.pop("restart_backoff_s", 0.05),
+        restart_backoff_max_s=0.2, **kw)
+
+
+def test_launcher_attempts_jsonl_and_recovery(tmp_path):
+    """Integration over REAL spawned workers (no jax in the child): two
+    failing attempts then success. attempts.jsonl carries one record per
+    attempt with rc, step progress, downtime (>= the backoff), and the
+    post-mortem goodput snapshot from the beacon."""
+    code = _run_chaos_child(tmp_path, "--fail_times", "2",
+                            max_restarts=5)
+    assert code == 0
+    recs = read_attempts(str(tmp_path))
+    assert [r["attempt"] for r in recs] == [0, 1, 2]
+    assert [r["rc"] for r in recs] == [1, 1, 0]
+    assert all(r["steps"] == 5 for r in recs)  # 5 fresh steps per attempt
+    assert recs[0]["downtime_s"] == 0.0
+    assert recs[1]["downtime_s"] >= 0.05       # the backoff slept
+    assert recs[2]["downtime_s"] >= 0.05       # progress resets the
+    # exponential (a preemption after real progress is not a crash loop)
+    assert recs[1]["goodput"]["useful_step_s"] > 0
+    assert recs[1]["resume_overhead_s"] is not None
+
+
+def test_launcher_backoff_doubles_without_progress(tmp_path):
+    """Attempts with UNKNOWN progress (no beacons: a non-TrainLoop script)
+    neither reset the exponential backoff nor trip the crash-loop
+    detector — the backoff doubles until the budget stops the run."""
+    code = _run_chaos_child(tmp_path, "--fail_times", "99", "--no_beacon",
+                            max_restarts=2)
+    assert code == 1
+    recs = read_attempts(str(tmp_path))
+    assert len(recs) == 3
+    assert all(r["steps"] is None for r in recs)  # progress unknown
+    assert recs[1]["downtime_s"] >= 0.05
+    assert recs[2]["downtime_s"] >= 0.1           # doubled
+
+
+def test_launcher_crash_loop_fails_fast(tmp_path):
+    """Zero step progress on two consecutive failed attempts stops the
+    run even with budget left: restarts are not fixing anything."""
+    code = _run_chaos_child(tmp_path, "--fail_times", "99",
+                            "--steps_per_attempt", "0",
+                            max_restarts=10)
+    assert code == 1
+    recs = read_attempts(str(tmp_path))
+    assert len(recs) == 2, "crash loop was not cut after 2 zero-progress " \
+                           "attempts"
+
+
+def test_launcher_budget_exhaustion_with_progress(tmp_path):
+    """Attempts that DO make progress never trip the crash-loop detector —
+    the sliding-window budget is what finally stops them."""
+    code = _run_chaos_child(tmp_path, "--fail_times", "99",
+                            max_restarts=2)
+    assert code == 1
+    recs = read_attempts(str(tmp_path))
+    assert len(recs) == 3  # initial + 2 budgeted restarts
+    assert all(r["steps"] == 5 for r in recs)
+
+
+def test_launcher_attempt_headers_in_worker_logs(tmp_path):
+    """Satellite: respawned rings append to the same worker_N.log, so the
+    launcher writes a '[launcher] attempt N' boundary line each attempt."""
+    log_dir = tmp_path / "wlogs"
+    code = _run_chaos_child(tmp_path / "run", "--fail_times", "1",
+                            max_restarts=2, log_dir=str(log_dir))
+    assert code == 0
+    log = (log_dir / "worker_0.log").read_text()
+    assert "[launcher] attempt 0\n" in log
+    assert "[launcher] attempt 1\n" in log
+    assert log.index("attempt 0") < log.index("CHAOSCHILD attempt=0")
+
+
+# ------------------------------------------------------------- e2e (slow)
+
+def _train_argv(steps, extra=()):
+    return ["--batch_size", "4", "--microbatch", "2", "--seq_len", "16",
+            "--vocab_size", "64", "--hidden_size", "32", "--num_layers",
+            "1", "--num_heads", "2", "--diffusion_steps", "50",
+            "--dtype", "float32", "--learning_steps", str(steps),
+            "--save_interval", "2", "--eval_interval", "1000000",
+            "--log_interval", "1000000", *extra]
+
+
+@pytest.mark.slow  # spawns 3 worker processes + an uninterrupted twin ring
+def test_chaos_ring_end_to_end_bit_continuous(tmp_path):
+    """The tentpole acceptance: a supervised CPU ring with an injected
+    SIGKILL at step 4 AND a corrupted newest checkpoint must (a) restart
+    into the SAME auto-generated run dir (DPT_RUN_TIMESTAMP pinning), (b)
+    walk back past the corrupt checkpoint to the last good step, (c) reach
+    the target step with parameters BIT-IDENTICAL to an uninterrupted run,
+    and (d) account for every second (attempts.jsonl + goodput records).
+
+    One supervised worker per ring: this image's jax cannot run
+    cross-process CPU collectives (pre-existing, CHANGES r6), and the
+    restart/resume/goodput path under test is identical."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the ring runs from the tmp cwd (auto run dirs land under it), so the
+    # repo must come from PYTHONPATH
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DPT_CHAOS_PLAN"] = json.dumps({"faults": [
+        {"kind": "corrupt_checkpoint", "step": 4, "rank": 0},
+        {"kind": "kill", "step": 4, "rank": 0},
+    ]})
+    chaos_cwd = tmp_path / "chaos"
+    chaos_cwd.mkdir()
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_pipeline_tpu.run.train",
+         "--distributed", "--nprocs", "1", "--max_restarts", "3",
+         "--restart_backoff_s", "0.1", *_train_argv(6)],
+        env=env, cwd=chaos_cwd, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+
+    # (a) one run dir: every attempt resolved the same pinned timestamp
+    runs = list((chaos_cwd / "model_checkpoints").glob("Run_*"))
+    assert len(runs) == 1, runs
+    run_dir = runs[0]
+    assert (run_dir / "model_000006").is_dir()
+
+    # (b) the corrupt newest was walked back past, and the restart resumed
+    # from the last good checkpoint (records prove actual recovery)
+    recs = read_attempts(str(run_dir))
+    assert len(recs) == 2
+    assert recs[0]["rc"] == -signal.SIGKILL and recs[0]["end_step"] == 4
+    assert recs[1]["rc"] == 0 and recs[1]["end_step"] == 6
+    assert (run_dir / ".chaos_fired_00").exists()  # corrupt fired once
+
+    # (d) every second accounted: useful+overheads+lost+downtime ~ wall
+    agg = aggregate_run(str(run_dir))
+    assert agg["attempts"] == 2
+    assert agg["goodput"] > 0
+    assert agg["recompute_s"] > 0  # steps 3-4 were re-run after walk-back
+    assert agg["accounted_frac"] == pytest.approx(1.0, abs=0.05)
+
+    # (c) bit-continuity: an UNINTERRUPTED ring with identical flags must
+    # produce bit-identical step-6 parameters (exact-order resume through
+    # kill + corruption + walk-back)
+    clean_cwd = tmp_path / "clean"
+    clean_cwd.mkdir()
+    env_clean = dict(env)
+    env_clean.pop("DPT_CHAOS_PLAN")
+    out2 = subprocess.run(
+        [sys.executable, "-m", "distributed_pipeline_tpu.run.train",
+         "--distributed", "--nprocs", "1", *_train_argv(6)],
+        env=env_clean, cwd=clean_cwd, capture_output=True, text=True,
+        timeout=300)
+    assert out2.returncode == 0, out2.stdout[-2000:] + out2.stderr[-2000:]
+    clean_run = next((clean_cwd / "model_checkpoints").glob("Run_*"))
+    target = {"abs": None}
+
+    def _restore(d):
+        wl = create_model_from_config(
+            model_family="diffuseq", vocab_size=64, seq_len=16,
+            hidden_size=32, num_layers=1, num_heads=2, diffusion_steps=50,
+            dtype="float32")
+        if target["abs"] is None:
+            target["abs"] = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                jax.eval_shape(wl.init_params, jax.random.PRNGKey(0)))
+        import flax.linen as nn
+        return ckpt.restore_checkpoint(
+            os.path.join(str(d), "model_000006"), nn.meta.unbox(target["abs"]))
+    a = _restore(run_dir)
+    b = _restore(clean_run)
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

@@ -26,6 +26,9 @@ GATED_PATHS = [
     # per-step host-sync breeding ground (the serving/ package itself is
     # inside the distributed_pipeline_tpu walk above)
     os.path.join(ROOT, "tests", "test_serving.py"),
+    # the chaos tests drive TrainLoop outer loops + fault hooks (chaos/
+    # itself rides the package walk above)
+    os.path.join(ROOT, "tests", "test_chaos.py"),
 ]
 
 
