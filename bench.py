@@ -46,7 +46,18 @@ the ``prefetch-ab-delta`` row reports the position-balanced totals ratio
 (ABBA ordering cancels the measured second-window position cost). Every
 train row carries ``steps_per_s`` plus the four stall-breakdown gauges
 (``data_wait_s``/``h2d_wait_s``/``dispatch_s``/``device_step_s``, mean
-seconds per step over the timed window).
+seconds per step over the timed window) and the HBM/params footprint
+columns (``params_bytes``/``opt_state_bytes``/
+``opt_state_bytes_per_replica``/``peak_live_bytes``, ISSUE 9).
+
+ZeRO-1 A/B (ISSUE 9): ``diffuseq-base-seq128-zero1`` runs the same
+paired-interleaved protocol between ``--shard_optimizer`` ON and OFF in a
+child process with a >= 2-way data axis (run/zero1_ab.py); the
+``zero1-ab-delta`` row reports steps/s parity plus the ~dp x per-replica
+optimizer-bytes drop.
+
+``BENCH_ONLY`` selects legs by EXACT name, or by glob when it contains a
+wildcard (``diffuseq-base-seq128*`` = the old substring behavior).
 
 Compile cost is first-class: a persistent XLA compilation cache
 (``BENCH_CACHE_DIR``, default ``model_checkpoints/bench/compile_cache``,
@@ -72,6 +83,22 @@ import signal
 import sys
 import threading
 import time
+
+
+def select_legs(legs, only):
+    """``BENCH_ONLY`` leg filter: EXACT name match, or an fnmatch glob
+    when the pattern contains a wildcard (``*``/``?``/``[``). The old
+    substring filter made ``BENCH_ONLY=diffuseq-base-seq128`` run seven
+    legs — chaos and the A/B twins included — when the point of the knob
+    is iterating on ONE leg; ``diffuseq-base-seq128*`` now spells the
+    old family-wide behavior explicitly."""
+    if not only:
+        return list(legs)
+    import fnmatch
+
+    if any(c in only for c in "*?["):
+        return [(n, f) for n, f in legs if fnmatch.fnmatchcase(n, only)]
+    return [(n, f) for n, f in legs if n == only]
 
 
 class LegTimeout(Exception):
@@ -305,6 +332,13 @@ def main() -> None:
             # even when tokens/sec still looks plausible
             "recompile_count": recompiles,
         }
+        # HBM/params footprint (ISSUE 9): logical + per-replica state
+        # bytes — opt_state_bytes_per_replica is the ZeRO-1 acceptance
+        # column — and the backend's peak live allocation (0 on CPU).
+        fp = loop.footprint()
+        row.update({k: fp[k] for k in (
+            "params_bytes", "opt_state_bytes",
+            "opt_state_bytes_per_replica", "peak_live_bytes")})
         # Stall breakdown over the timed window (mean s/step): data_wait_s
         # (blocked on the host iterator), h2d_wait_s (blocked on transfer/
         # placement), dispatch_s (enqueue), device_step_s (trailing
@@ -708,6 +742,61 @@ def main() -> None:
         if batch != requested_batch:
             row["ab_batch_fallback"] = True
         row.update({k: round(v, 6) for k, v in stall.items()})
+        fp = loop_on.footprint()
+        row.update({k: fp[k] for k in (
+            "params_bytes", "opt_state_bytes",
+            "opt_state_bytes_per_replica", "peak_live_bytes")})
+        return row
+
+    def measure_zero1_ab(name: str, *, batch: int, microbatch: int,
+                         seq_len: int, window_steps: int, rounds: int):
+        """ZeRO-1 A/B leg (ISSUE 9): paired interleaved shard_optimizer
+        ON/OFF at the headline shape on a >= 2-way data axis, run in a
+        CHILD PROCESS (run/zero1_ab.py) so the CPU smoke box — one real
+        device — still gets a dp=2 mesh via forced host devices; on TPU
+        the child sees the real chips. The row's acceptance numbers:
+        ``opt_bytes_replica_ratio`` ~ dp (per-replica optimizer+EMA bytes
+        drop by the data-parallel factor) while ``ab_delta_pct`` stays
+        inside the box noise band (steps/s parity — ZeRO-1 trades a
+        per-step update all-gather for dp x less weight-update memory)
+        and ``steady_recompile_count`` == 0 (pinned out_shardings: the
+        sharded layout compiles exactly once)."""
+        import subprocess
+
+        env = dict(os.environ)
+        args = ["--family", "diffuseq", "--size", "base",
+                "--batch", str(batch), "--microbatch", str(microbatch),
+                "--seq_len", str(seq_len), "--dtype", dtype,
+                "--window_steps", str(window_steps),
+                "--rounds", str(rounds)]
+        if not on_tpu:
+            env.update({"JAX_PLATFORMS": "cpu",
+                        "XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=2"})
+            # Wider than the usual CPU smoke dims (hidden 256 vs 64): the
+            # per-step weight-update all-gather is a fixed ~per-leaf op
+            # cost on CPU, so the step must carry enough matmul for the
+            # parity contract to be measurable (at hidden 64 the op
+            # overhead alone reads as -15%; at 256 the delta sits inside
+            # the +-3% noise band — measured on this box)
+            args += ["--hidden", "256", "--layers", "2", "--heads", "4",
+                     "--vocab", "256"]
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "distributed_pipeline_tpu.run.zero1_ab"] + args,
+                env=env, capture_output=True, text=True, timeout=200,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            return {"name": name,
+                    "error": "zero1 A/B child exceeded its 200s timeout"}
+        lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+        if proc.returncode != 0 or not lines:
+            tail = (proc.stderr or proc.stdout or "")[-300:]
+            return {"name": name,
+                    "error": f"zero1 A/B child rc={proc.returncode}: {tail}"}
+        row = json.loads(lines[-1])
+        row["name"] = name
         return row
 
     # Per-chip batch sizes are the measured MFU sweet spots on v5e (base:
@@ -743,6 +832,21 @@ def main() -> None:
             rounds=6 if on_tpu else 32,
             prefetch_depth=int(os.environ.get("BENCH_PREFETCH_DEPTH", "2")),
             dispatch_lag=int(os.environ.get("BENCH_DISPATCH_LAG", "1")))),
+        # ZeRO-1 A/B (ISSUE 9): the headline shape with cross-replica
+        # optimizer/EMA sharding ON vs OFF, paired-interleaved in a child
+        # process on a >= 2-way data axis (forced 2 host devices on the
+        # CPU box; the real chips on TPU). Acceptance: per-replica
+        # optimizer bytes / dp at steps/s parity, steady recompiles 0.
+        ("diffuseq-base-seq128-zero1", functools.partial(
+            measure_zero1_ab, "diffuseq-base-seq128-zero1",
+            # CPU smoke: batch 8 unaccumulated (the child's dp=2 mesh
+            # needs the microbatch divisible by 2, and the wider CPU
+            # model wants the larger per-step compute — see
+            # measure_zero1_ab's dims note)
+            batch=256 if on_tpu else 8,
+            microbatch=64 if on_tpu else 8, seq_len=128,
+            window_steps=10 if on_tpu else 6,
+            rounds=6 if on_tpu else 8)),
         # Serving decode legs (ISSUE 7): continuous-batching decode
         # tokens/s/chip at 1 / 8 / 64 slots plus time-to-first-token,
         # through the prefill/decode AOT split + paged KV cache
@@ -877,8 +981,8 @@ def main() -> None:
     ]
 
     only = os.environ.get("BENCH_ONLY", "")
-    if only:  # iteration filter: BENCH_ONLY=<substring>
-        legs = [(n, f) for n, f in legs if only in n]
+    if only:  # iteration filter: BENCH_ONLY=<exact name | *glob*>
+        legs = select_legs(legs, only)
 
     # Fresh artifact per run (a crash mid-run leaves the completed prefix).
     if artifact_path:
@@ -1037,6 +1141,22 @@ def main() -> None:
                   "window_steps": on["ab_window_steps"],
                   "prefetch_depth": on.get("prefetch_depth"),
                   "dispatch_lag": on.get("dispatch_lag")})
+
+        # ZeRO-1 acceptance row (ISSUE 9): the headline-twin A/B's two
+        # numbers in one place — per-replica optimizer-bytes ratio (~dp)
+        # and the paired steps/s delta (parity within the noise band).
+        z = next((c for c in configs
+                  if c.get("name") == "diffuseq-base-seq128-zero1"
+                  and "opt_bytes_replica_ratio" in c), None)
+        if z:
+            emit({"name": "zero1-ab-delta",
+                  "off_steps_per_s": z["ab_off_steps_per_s"],
+                  "on_steps_per_s": z["steps_per_s"],
+                  "delta_pct": z["ab_delta_pct"],
+                  "opt_bytes_replica_ratio": z["opt_bytes_replica_ratio"],
+                  "dp": z["dp"],
+                  "steady_recompile_count": z.get("steady_recompile_count"),
+                  "method": "paired-interleaved"})
 
         # The headline contract holds only for a FULL leg list (legs[0] is
         # the DiffuSeq north star). Under BENCH_ONLY (iteration mode) the

@@ -15,6 +15,10 @@ GL007 host-sync-in-loop    float()/np.asarray/.item() on a jitted step's
                            output inside the outer (untraced) training
                            loop — a per-step host sync that defeats async
                            dispatch (dispatch_lag)
+GL008 hand-wired-sharding  NamedSharding constructed (or a PartitionSpec
+                           passed directly as a sharding) outside the
+                           partition engine — sharding belongs in rule
+                           tables (parallel/partition.py), not call sites
 """
 
 from __future__ import annotations
@@ -703,3 +707,82 @@ class HostSyncInLoop(Rule):
             node = node.value
         return isinstance(node, ast.Call) and self._is_step_call(module,
                                                                  node)
+
+
+# --------------------------------------------------------------------- GL008
+
+# The partition engine: the only modules allowed to BIND specs to meshes.
+# parallel/partition.py is the rule engine itself; parallel/sharding.py is
+# its compat shim (flax logical metadata + the batch/IO helpers).
+_GL008_ENGINE = ("parallel/partition.py", "parallel/sharding.py")
+_GL008_NAMED_SHARDING = "jax.sharding.NamedSharding"
+_GL008_PSPEC = "jax.sharding.PartitionSpec"
+# kwarg names through which a bare PartitionSpec acts as a sharding at the
+# call site (jit/device_put surfaces). shard_map's in_specs/out_specs are
+# deliberately NOT here: those are engine-level SPMD plumbing (pipeline /
+# ring internals), not a parameter-sharding decision.
+_GL008_SHARDING_KWARGS = {"in_shardings", "out_shardings", "out_sharding",
+                          "sharding"}
+
+
+@register
+class HandWiredSharding(Rule):
+    """GL008: a ``NamedSharding`` constructed — or a ``PartitionSpec``
+    passed directly as a sharding — outside the partition engine. Hand-
+    wired sharding trees are exactly what the regex-rule engine
+    (parallel/partition.py: ``match_partition_rules`` + per-model tables)
+    replaced: a spec decided at a call site is invisible to the rule
+    tables, drifts from them silently, and puts the next model back to
+    editing engine code. Declare a rule (or use the engine/sharding
+    helpers: ``replicated``, ``batch_shardings``, ``resolve_shardings``,
+    ``make_shard_and_gather_fns``) instead. Bare ``PartitionSpec``
+    construction stays legal — rule tables and shard_map specs are made
+    of them; only using one AS a sharding (device_put target,
+    in_/out_shardings=) is flagged."""
+
+    code = "GL008-hand-wired-sharding"
+    description = ("NamedSharding/PartitionSpec hand-wired as a sharding "
+                   "outside parallel/partition.py|sharding.py — declare a "
+                   "partition rule or use the sharding helpers")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if any(path.endswith(e) for e in _GL008_ENGINE):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = module.resolve(node.func)
+            if fn == _GL008_NAMED_SHARDING:
+                yield module.finding(
+                    self, node,
+                    "NamedSharding constructed outside the partition "
+                    "engine — declare a partition rule "
+                    "(parallel/partition.py) or use the sharding helpers "
+                    "(replicated/batch_shardings/resolve_shardings)")
+            elif fn == _GL008_PSPEC and self._used_as_sharding(module,
+                                                              node):
+                yield module.finding(
+                    self, node,
+                    "PartitionSpec passed directly as a sharding — bind "
+                    "specs to meshes through the partition engine "
+                    "(resolve_shardings/make_shard_and_gather_fns), not "
+                    "at the call site")
+
+    @staticmethod
+    def _used_as_sharding(module: Module, node: ast.Call) -> bool:
+        parent = module.parent.get(node)
+        if isinstance(parent, ast.keyword) \
+                and parent.arg in _GL008_SHARDING_KWARGS:
+            return True
+        if isinstance(parent, ast.keyword) and parent.arg == "device":
+            # device= is generic; only a device_put target is a sharding
+            grand = module.parent.get(parent)
+            return isinstance(grand, ast.Call) \
+                and module.resolve(grand.func) == "jax.device_put"
+        if isinstance(parent, ast.Call):
+            fn = module.resolve(parent.func)
+            if fn in ("jax.device_put", "jax.lax.with_sharding_constraint") \
+                    and len(parent.args) >= 2 and parent.args[1] is node:
+                return True
+        return False

@@ -163,6 +163,20 @@ class MeshSettings(S):
     expert: int = _(1, "expert-parallel axis size (MoE expert sharding)")
     pipe: int = _(1, "pipeline-parallel axis size (GPipe stage streaming; "
                      "requires --scan_layers true)")
+    shard_optimizer: bool = _(
+        False, "ZeRO-1 cross-replica weight-update sharding: Adam moments "
+               "and EMA copies sharded across the data mesh axis with "
+               "gather-on-use inside the compiled train step — per-replica "
+               "optimizer/EMA memory drops ~dp x at unchanged step math "
+               "(params/grads keep their layout; checkpoints restore "
+               "across the flag in either direction)")
+    partition_rules: str = _(
+        "", "override the model's parameter partition-rule table "
+            "(parallel/partition.py): inline JSON, @/path.json, or a bare "
+            "path — an ordered list of [path-regex, spec] pairs, spec a "
+            "list of mesh-axis names / null / nested list (several axes "
+            "on one dim), ending with an explicit catch-all ['.*', []]; "
+            "empty = the model family's built-in table")
 
 
 class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):

@@ -72,6 +72,12 @@ class Workload:
                              Dict[str, jnp.ndarray]]
     example_batch: Callable[[int], Dict[str, np.ndarray]]
     schedule: Optional[DiffusionSchedule] = None
+    # Declared sharding (parallel/partition.py): ordered (path-regex,
+    # PartitionSpec) rules the trainer resolves into NamedShardings. None
+    # falls back to the family's built-in table (rules_for_workload), and
+    # unknown families to the flax logical-metadata compat path — a new
+    # model declares a table here instead of editing the engine.
+    partition_rules: Optional[Tuple[Tuple[str, Any], ...]] = None
 
     def init_params(self, rng: jax.Array) -> Any:
         """Initialize parameters from a dummy batch (shapes only)."""
@@ -141,6 +147,12 @@ def create_model_from_config(*, model_family: str = "diffuseq",
     heads = num_heads or preset[2]
     jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
+    # Declared sharding: the family's partition-rule table rides the
+    # Workload (parallel/partition.py; function-level import keeps the
+    # models layer import-light for tools that only build modules).
+    from ..parallel.partition import DIFFUSEQ_RULES, GPT2_RULES
+    rules = DIFFUSEQ_RULES if model_family == "diffuseq" else GPT2_RULES
+
     if model_family == "diffuseq":
         model = DiffuSeqModel(
             vocab_size=vocab_size, seq_len=seq_len, hidden_size=hidden,
@@ -160,7 +172,7 @@ def create_model_from_config(*, model_family: str = "diffuseq",
                         hidden_size=hidden, num_layers=layers,
                         compute_losses=compute_losses,
                         example_batch=_example_batch_fn(seq_len),
-                        schedule=schedule)
+                        schedule=schedule, partition_rules=rules)
 
     else:  # "gpt2" — PRESETS membership was validated above
         model = GPT2Model(
@@ -179,7 +191,8 @@ def create_model_from_config(*, model_family: str = "diffuseq",
         return Workload(model=model, family="gpt2", seq_len=seq_len,
                         hidden_size=hidden, num_layers=layers,
                         compute_losses=compute_losses,
-                        example_batch=_example_batch_fn(seq_len))
+                        example_batch=_example_batch_fn(seq_len),
+                        partition_rules=rules)
 
 
 def seed_all(seed: int, deterministic: bool = False) -> jax.Array:

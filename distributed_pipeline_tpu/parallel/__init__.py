@@ -14,3 +14,15 @@ from .dist import (
 )
 from .launcher import parse_and_autorun, parse_distributed_args
 from .mesh import AXES, batch_spec, make_mesh, resolve_axis_sizes
+from . import partition
+from .partition import (
+    DIFFUSEQ_RULES,
+    GPT2_RULES,
+    MOE_RULES,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    parse_partition_rules,
+    resolve_shardings,
+    rules_for_workload,
+    zero1_shardings,
+)

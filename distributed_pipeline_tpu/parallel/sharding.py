@@ -1,12 +1,20 @@
-"""Sharding rules: logical axis names -> mesh axes.
+"""Sharding compat surface: flax logical axis names -> mesh axes.
 
-This file is the whole "parallelism engine" — the TPU-native replacement for
-the reference's DDP wrapper (``/root/reference/utils/trainer.py:115-128``) and
-the hook its `grad_clip` leaves for sharded optimizers (``trainer.py:246-255``).
-Models annotate weights with logical names (models/backbone.py); this module
-maps them onto the mesh; XLA inserts every collective. Changing parallelism
-strategy (DP -> FSDP -> +TP) is a rules/mesh change, zero engine code
-(SURVEY.md §2.2, BASELINE.md configs 2/3/5).
+The parameter-sharding ENGINE now lives in ``parallel/partition.py`` (the
+regex-rule tables + ZeRO-1 layer, ISSUE 9): models declare ordered
+``(path-regex, PartitionSpec)`` tables and the trainer resolves them
+there. This module remains as (a) the thin compat shim for models that
+still carry flax ``nn.with_logical_partitioning`` metadata —
+:func:`param_shardings` translates their logical specs and delegates the
+materialization (divisibility fix, NamedSharding binding) to the engine —
+and (b) the batch/IO helpers (:func:`batch_shardings`,
+:func:`shard_batch`, :func:`replicated`), which shard data, not params.
+
+Historical note: this file used to BE the parallelism engine (the
+TPU-native replacement for the reference's DDP wrapper,
+``/root/reference/utils/trainer.py:115-128``); changing strategy is still
+a rules/mesh change with zero engine code, the rules just moved to
+partition tables.
 """
 
 from __future__ import annotations
@@ -56,23 +64,18 @@ LOGICAL_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
 def param_shardings(mesh: Mesh, abstract_variables: Any,
                     rules: Sequence[Tuple[str, Any]] = LOGICAL_RULES) -> Any:
     """NamedShardings for a (possibly abstract) boxed variables tree carrying
-    flax logical-partitioning metadata. Axes whose size the dim doesn't divide
-    fall back to replication (so tiny test models shard cleanly)."""
+    flax logical-partitioning metadata. Compat shim: the logical names are
+    translated to mesh specs here, then the partition engine materializes
+    them (axes whose size the dim doesn't divide fall back to replication
+    — partition.fix_spec, so tiny test models shard cleanly)."""
+    from .partition import resolve_shardings
+
     specs = nn.get_partition_spec(abstract_variables)
     shapes = jax.tree_util.tree_map(lambda x: x.shape,
                                     nn.meta.unbox(abstract_variables))
-
-    def fix(spec: P, shape) -> NamedSharding:
-        fixed = []
-        for dim, ax in zip(shape, tuple(spec) + (None,) * len(shape)):
-            axes = ax if isinstance(ax, tuple) else (ax,) if ax else ()
-            size = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
-            fixed.append(ax if size > 1 and dim % size == 0 else None)
-        return NamedSharding(mesh, P(*fixed))
-
     mesh_specs = nn.logical_to_mesh_sharding(specs, mesh, list(rules))
-    return jax.tree_util.tree_map(
-        lambda s, shape: fix(s.spec, shape), mesh_specs, shapes)
+    spec_tree = jax.tree_util.tree_map(lambda s: s.spec, mesh_specs)
+    return resolve_shardings(mesh, spec_tree, shapes)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -168,6 +168,7 @@ def main(namespace: argparse.Namespace) -> None:
     # order resolved the resume target before construction, which a
     # walk-back would silently desync from the data stream.
     from ..chaos.goodput import beacon_max_step
+    from ..parallel.partition import parse_partition_rules
     from ..utils.checkpoint import load_meta
     loop = TrainLoop(
         model=workload,
@@ -199,6 +200,10 @@ def main(namespace: argparse.Namespace) -> None:
         # beacons) book as recompute, not useful — goodput accounting for
         # the lost last-checkpoint..crash window.
         recompute_until_step=beacon_max_step(ckpt_path),
+        # Auto-sharding engine knobs: ZeRO-1 weight-update sharding and
+        # the per-run partition-rule override (parallel/partition.py).
+        shard_optimizer=args.shard_optimizer,
+        partition_rules=parse_partition_rules(args.partition_rules),
     )
 
     # Exact-resume data order: fast-forward both streams so the continued

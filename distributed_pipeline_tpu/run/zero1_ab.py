@@ -1,0 +1,153 @@
+"""Paired ZeRO-1 A/B measurement (bench leg ``diffuseq-base-seq128-zero1``).
+
+Run as a CHILD PROCESS by bench.py so the mesh can have a >= 2-way data
+axis even on the single-device CPU smoke box (the parent forces
+``--xla_force_host_platform_device_count=2`` there; on TPU the real
+devices are used as-is). Two TrainLoops at identical settings — ZeRO-1
+OFF and ON (``shard_optimizer``) — stay alive while short timed windows
+interleave between them in ABBA order, exactly the
+``measure_prefetch_ab`` protocol: sequential legs measure the box's rate
+drift as much as the code, interleaving hits both arms with the same
+drift, and even-round ABBA cancels the second-window position cost in
+the summed totals.
+
+Prints ONE machine-readable JSON row on stdout (the parent parses the
+last line): steps/s for both arms, the paired delta, and the
+per-replica optimizer/EMA byte footprints whose ~dp x drop is the
+acceptance number — steps/s parity within the box noise band while
+``opt_state_bytes_per_replica`` divides by the data-parallel factor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def create_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--family", default="diffuseq")
+    ap.add_argument("--size", default="base")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--microbatch", type=int, default=0)
+    ap.add_argument("--seq_len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--hidden", type=int, default=0, help="0 = preset")
+    ap.add_argument("--layers", type=int, default=0, help="0 = preset")
+    ap.add_argument("--heads", type=int, default=0, help="0 = preset")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--window_steps", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=6)
+    return ap
+
+
+def main(argv=None) -> None:
+    args = create_parser().parse_args(argv)
+    rounds = args.rounds + (args.rounds % 2)  # even: ABBA position balance
+
+    import jax
+
+    from ..data import load_data_from_args
+    from ..models import create_model_from_config
+    from ..parallel import make_mesh
+    from ..utils import logger
+    from ..utils.trainer import TrainLoop
+
+    # stdout carries the ONE JSON row; silence the logger's default sink.
+    logger.configure(format_strs=[])
+
+    dataset = "synthetic-lm" if args.family == "gpt2" else "synthetic-seq2seq"
+
+    def build(shard: bool) -> TrainLoop:
+        wl = create_model_from_config(
+            model_family=args.family, model_size=args.size,
+            seq_len=args.seq_len, vocab_size=args.vocab,
+            hidden_size=args.hidden, num_layers=args.layers,
+            num_heads=args.heads, dtype=args.dtype)
+        data = load_data_from_args(
+            "train", batch_size=args.batch, dataset=dataset,
+            seq_len=args.seq_len, vocab_size=args.vocab, seed=0,
+            num_loader_proc=2)
+        # Both arms sanitize (symmetric timing; recompile gauge rides the
+        # ON arm). All devices on the data axis: the pure-DP mesh is where
+        # ZeRO-1 buys the most and the layouts differ the most.
+        return TrainLoop(model=wl, data=data, batch_size=args.batch,
+                         microbatch=args.microbatch or args.batch, lr=1e-4,
+                         ema_rate="0.9999", learning_steps=0,
+                         log_interval=10 ** 9, save_interval=10 ** 9,
+                         mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0,
+                         sanitize=True, shard_optimizer=shard)
+
+    def warmup(loop: TrainLoop) -> None:
+        for _ in range(3):
+            m = loop.run_step(loop.next_batch())
+        float(jax.device_get(m["loss"]))
+
+    def window(loop: TrainLoop) -> float:
+        t0 = time.perf_counter()
+        for _ in range(args.window_steps):
+            m = loop.run_step(loop.next_batch())
+        float(jax.device_get(m["loss"]))
+        return time.perf_counter() - t0
+
+    # OFF arm built and warmed FIRST so the ON arm's RecompileMonitor
+    # never sees the OFF arm's construction compiles (the
+    # measure_prefetch_ab ordering rationale); uninstalled in reverse.
+    loop_off = build(False)
+    try:
+        warmup(loop_off)
+        loop_on = build(True)
+        try:
+            warmup(loop_on)
+            off_dts: list = []
+            on_dts: list = []
+            for r in range(rounds):
+                pair = ((loop_off, off_dts), (loop_on, on_dts))
+                for loop, dts in (pair[::-1] if r % 2 else pair):
+                    dts.append(window(loop))
+            fp_on = loop_on.footprint()
+            fp_off = loop_off.footprint()
+            steady_recompiles = loop_on.steady_recompile_count
+        finally:
+            recompiles = loop_on.stop_sanitizer()
+    finally:
+        loop_off.stop_sanitizer()
+
+    n_steps = rounds * args.window_steps
+    off_sps = n_steps / sum(off_dts)
+    on_sps = n_steps / sum(on_dts)
+    mesh_dp = loop_on.mesh.shape["data"]
+    opt_pr_on = fp_on["opt_state_bytes_per_replica"]
+    opt_pr_off = fp_off["opt_state_bytes_per_replica"]
+    out = {
+        "steps_per_s": round(on_sps, 4),
+        "ab_off_steps_per_s": round(off_sps, 4),
+        # identical step counts: the totals ratio IS the rate ratio
+        "ab_delta_pct": round(100.0 * (sum(off_dts) / sum(on_dts) - 1.0), 2),
+        "ab_method": "paired-interleaved",
+        "ab_rounds": rounds, "ab_window_steps": args.window_steps,
+        "dp": mesh_dp,
+        "n_devices": jax.device_count(),
+        "batch": args.batch, "microbatch": args.microbatch or args.batch,
+        "seq_len": args.seq_len,
+        "n_params": loop_on.n_params,
+        "params_bytes": fp_on["params_bytes"],
+        "opt_state_bytes": fp_on["opt_state_bytes"],
+        "opt_state_bytes_per_replica": opt_pr_on,
+        "ab_off_opt_state_bytes_per_replica": opt_pr_off,
+        # the acceptance number: ~dp when every big leaf shards
+        "opt_bytes_replica_ratio": round(opt_pr_off / max(opt_pr_on, 1), 2),
+        "ema_bytes_per_replica": fp_on["ema_bytes_per_replica"],
+        "ab_off_ema_bytes_per_replica": fp_off["ema_bytes_per_replica"],
+        "peak_live_bytes": fp_on["peak_live_bytes"],
+        "compile_s": round(loop_on.compile_time_s or 0.0, 3),
+        "recompile_count": recompiles,
+        "steady_recompile_count": steady_recompiles,
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

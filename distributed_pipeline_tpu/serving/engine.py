@@ -36,9 +36,9 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.sampling import _truncate_logits
+from ..parallel.sharding import replicated
 from ..utils.perf import AOTStep
 
 __all__ = ["DecodeEngine"]
@@ -228,7 +228,7 @@ class DecodeEngine:
             # kills the same way). Replicated state is the correctness-first
             # baseline; a TP pages layout rides the flash-decode kernel
             # later (ROADMAP item 4).
-            rep = NamedSharding(mesh, P())
+            rep = replicated(mesh)
             cache_rep = jax.tree_util.tree_map(lambda _: rep, cache_abs)
             okw_p["out_shardings"] = (cache_rep, rep, rep)
             okw_d["out_shardings"] = (cache_rep, rep, rep, rep)
@@ -258,7 +258,7 @@ class DecodeEngine:
         key = rng if rng is not None else jax.random.PRNGKey(seed)
         self._key = self._put_key(key)
         if mesh is not None:
-            rep = NamedSharding(mesh, P())
+            rep = replicated(mesh)
             self.cache = jax.device_put(self.cache,
                                         jax.tree_util.tree_map(
                                             lambda _: rep, cache_abs))
@@ -267,11 +267,11 @@ class DecodeEngine:
 
     def _put(self, x: np.ndarray) -> jax.Array:
         if self.mesh is not None:
-            return jax.device_put(x, NamedSharding(self.mesh, P()))
+            return jax.device_put(x, replicated(self.mesh))
         return jax.device_put(x)
 
     def _put_key(self, key: jax.Array) -> jax.Array:
-        return (jax.device_put(key, NamedSharding(self.mesh, P()))
+        return (jax.device_put(key, replicated(self.mesh))
                 if self.mesh is not None else key)
 
     def _ctx(self):

@@ -365,6 +365,7 @@ def restore_checkpoint(path: str, abstract_target: Any) -> Any:
 def restore_resume_state(directory: str, *, abstract_params: Any,
                          ema_rates: Tuple[str, ...] = (),
                          abstract_opt: Any = None,
+                         abstract_ema: Any = None,
                          explicit_model_path: str = "") -> Optional[Dict[str, Any]]:
     """The full auto-resume dance (reference ``_load_and_sync_parameters`` +
     ``_load_ema_parameters`` + ``_load_optimizer_state``,
@@ -372,6 +373,13 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
     explicit one), then fetch companion EMA/opt states by naming convention.
     Missing companions degrade to the restored params (the reference seeds
     EMA from params, trainer.py:110-113). Returns None when nothing to resume.
+
+    ``abstract_ema`` is the EMA restore target when its layout differs
+    from the params' (ZeRO-1: EMA sharded across the data axis while
+    params replicate over it); defaults to ``abstract_params``. Degraded
+    (missing/corrupt) companions are placed into that layout too — the
+    trainer's AOT step pins its state shardings, so a params-layout EMA
+    would be rejected at the second step.
     """
     if explicit_model_path:
         # An explicitly requested resume must never silently fall through to
@@ -427,18 +435,28 @@ def restore_resume_state(directory: str, *, abstract_params: Any,
     out: Dict[str, Any] = {"step": step, "params": params, "ema": {},
                            "opt_state": None, "path": model_path}
     directory = os.fspath(epath.Path(model_path).parent)
+    abs_ema = abstract_ema if abstract_ema is not None else abstract_params
 
     def _degraded(rate: str) -> Any:
         # Missing/unrestorable companion degrades to a COPY of params
         # (reference seeds EMA from params, trainer.py:110-113) — never an
         # alias, which would be donated twice by the jitted step and crash.
+        # The copy is then PLACED into the EMA layout: under ZeRO-1 that
+        # differs from the params layout, and the step's pinned shardings
+        # make a mislaid EMA a hard error one step later. (device_put is
+        # an explicit transfer — legal under the sanitizer's guard; on an
+        # identical layout it's a no-op over the fresh copy.)
         import jax.numpy as jnp
-        return jax.tree_util.tree_map(jnp.copy, params)
+        copy = jax.tree_util.tree_map(jnp.copy, params)
+        if abstract_ema is None:
+            return copy
+        return jax.device_put(
+            copy, jax.tree_util.tree_map(lambda a: a.sharding, abs_ema))
 
     for rate in ema_rates:
         p = find_ema_checkpoint(directory, step, rate)
         try:
-            out["ema"][rate] = (restore_checkpoint(p, abstract_params)
+            out["ema"][rate] = (restore_checkpoint(p, abs_ema)
                                 if p else _degraded(rate))
         except Exception as e:  # corrupt companion: degrade like missing
             logger.warn(f"resume: EMA companion {p} failed to restore "

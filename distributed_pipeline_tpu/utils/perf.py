@@ -19,7 +19,8 @@ import jax
 __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
            "StepTimer", "mfu", "enable_persistent_compilation_cache",
            "timed_lower_compile", "AOTStep", "RecompileMonitor",
-           "StallBreakdown", "EventStats", "GoodputTracker"]
+           "StallBreakdown", "EventStats", "GoodputTracker",
+           "tree_bytes", "tree_bytes_per_replica", "peak_live_bytes"]
 
 # Peak dense bf16 FLOP/s per chip (public spec sheets), matched IN ORDER
 # against jax's device_kind strings — real hardware reports e.g.
@@ -57,6 +58,49 @@ def mfu(tokens_per_sec: float, flops_per_token: float,
         n_devices: Optional[int] = None) -> float:
     n = n_devices if n_devices is not None else jax.device_count()
     return tokens_per_sec * flops_per_token / (device_peak_flops() * n)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Logical (global, unsharded) bytes of a pytree of arrays/abstract
+    values — the model-size side of the HBM footprint gauges."""
+    import numpy as np
+
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "shape") and hasattr(l, "dtype"))
+
+
+def tree_bytes_per_replica(tree: Any) -> int:
+    """Bytes of ONE device's shard of each leaf — what a replica actually
+    holds. For ZeRO-1-sharded optimizer/EMA state this is the number that
+    drops by ~dp vs :func:`tree_bytes`; unsharded leaves count in full."""
+    import numpy as np
+
+    total = 0
+    for l in jax.tree_util.tree_leaves(tree):
+        if not (hasattr(l, "shape") and hasattr(l, "dtype")):
+            continue
+        sharding = getattr(l, "sharding", None)
+        shape = (sharding.shard_shape(l.shape) if sharding is not None
+                 else l.shape)
+        total += int(np.prod(shape)) * np.dtype(l.dtype).itemsize
+    return total
+
+
+def peak_live_bytes() -> int:
+    """Peak live device allocation summed over local devices, from the
+    backend's memory stats (``peak_bytes_in_use``); 0 where the backend
+    reports none (CPU) — the gauge is then "unavailable", not "empty"."""
+    total = 0
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            return 0
+        if not stats:
+            return 0
+        total += int(stats.get("peak_bytes_in_use", 0))
+    return total
 
 
 def enable_persistent_compilation_cache(flag: str = "auto",

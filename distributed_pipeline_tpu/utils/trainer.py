@@ -48,6 +48,7 @@ from jax.sharding import Mesh
 from ..data.device_prefetch import DeviceBatch, prefetch_to_device
 from ..models import Workload
 from ..parallel import mesh as mesh_lib
+from ..parallel import partition as partition_lib
 from ..parallel.sharding import (
     batch_shardings,
     param_shardings,
@@ -57,7 +58,8 @@ from ..parallel.sharding import (
 from . import checkpoint as ckpt_lib
 from . import logger
 from .perf import AOTStep, GoodputTracker, RecompileMonitor, StallBreakdown, \
-    StepTimer, device_peak_flops, mfu, transformer_train_flops_per_token
+    StepTimer, device_peak_flops, mfu, peak_live_bytes, tree_bytes, \
+    tree_bytes_per_replica, transformer_train_flops_per_token
 
 __all__ = ["TrainLoop", "TrainState", "update_ema"]
 
@@ -123,6 +125,8 @@ class TrainLoop:
         chaos: Optional[Any] = None,
         progress_file: str = "",
         recompute_until_step: int = 0,
+        shard_optimizer: bool = False,
+        partition_rules: Optional[Sequence[Tuple[str, Any]]] = None,
     ) -> None:
         # Time-to-signal accounting starts at construction: everything up
         # to the end of the first optimizer step (state init, restore,
@@ -205,6 +209,17 @@ class TrainLoop:
         self.chaos = chaos
         self.progress_file = progress_file
         self.recompute_until_step = recompute_until_step
+
+        # Auto-sharding engine (ISSUE 9): params shard by the workload's
+        # declared partition-rule table (parallel/partition.py) —
+        # ``partition_rules`` overrides it per run; workloads with neither
+        # keep the flax logical-metadata compat path. ``shard_optimizer``
+        # turns on ZeRO-1: Adam moments and EMA copies additionally
+        # sharded across the data mesh axis with gather-on-use inside the
+        # compiled step (per-replica weight-update memory / ~dp).
+        self.shard_optimizer = shard_optimizer
+        self.partition_rules = (tuple(partition_rules)
+                                if partition_rules else None)
         self.goodput = GoodputTracker(t0=self._construct_t0)
         spawn_t = os.environ.get("DPT_SPAWN_T", "")
         if spawn_t:
@@ -360,20 +375,47 @@ class TrainLoop:
         wl = self.workload
         init_rng = jax.random.fold_in(self._base_rng, 0)
         abstract = jax.eval_shape(wl.init_params, init_rng)
-        pshard = param_shardings(self.mesh, abstract)
+        abstract_unboxed = nn.meta.unbox(abstract)
+        # Param shardings from the declared rule table (the partition
+        # engine); --partition_rules overrides, and workloads without a
+        # table (custom families) fall back to the flax logical-metadata
+        # compat path. The tables are equivalence-tested against that
+        # path, so flipping engines never changes a layout.
+        rules = (self.partition_rules
+                 if self.partition_rules is not None
+                 else partition_lib.rules_for_workload(wl))
+        if rules is not None:
+            specs = partition_lib.match_partition_rules(rules,
+                                                        abstract_unboxed)
+            pshard = partition_lib.resolve_shardings(self.mesh, specs,
+                                                     abstract_unboxed)
+        else:
+            pshard = param_shardings(self.mesh, abstract)
         self._pshard = pshard
         self.opt = self._make_optimizer()
 
-        # Optimizer-state shardings: params-shaped leaves (mu/nu) inherit the
-        # param shardings — the FSDP/ZeRO contract that keeps the 2x Adam
-        # memory sharded like the weights (SURVEY.md §7 hard parts) — and
+        # ZeRO-1 (--shard_optimizer): weight-update state — Adam moments
+        # AND the EMA copies — lives sharded across the data axis on top
+        # of whatever fsdp/tensor sharding the params already have. The
+        # step only touches that state elementwise, so GSPMD gathers on
+        # use (all-gather of the per-step updates, not the stored state)
+        # and per-replica weight-update bytes drop by ~dp. With dp == 1
+        # (or the flag off) the ZeRO layout degenerates to the param
+        # layout and nothing changes.
+        zshard = (partition_lib.zero1_shardings(self.mesh, pshard,
+                                                abstract_unboxed)
+                  if self.shard_optimizer else pshard)
+        self._zshard = zshard
+
+        # Optimizer-state shardings: params-shaped leaves (mu/nu) take the
+        # weight-update layout — the param shardings (FSDP/ZeRO-3 contract,
+        # SURVEY.md §7 hard parts), plus the data axis under ZeRO-1 — and
         # scalars (count) replicate. jit does NOT propagate input shardings
         # to outputs, so this must be explicit.
         rep = replicated(self.mesh)
-        abstract_unboxed = nn.meta.unbox(abstract)
         abstract_opt = jax.eval_shape(self.opt.init, abstract_unboxed)
         oshard = optax.tree_map_params(
-            self.opt, lambda _, s: s, abstract_opt, pshard,
+            self.opt, lambda _, s: s, abstract_opt, zshard,
             transform_non_params=lambda _: rep)
         self._oshard = oshard
 
@@ -385,9 +427,17 @@ class TrainLoop:
             # Fresh EMA = copy of params (reference deepcopies,
             # trainer.py:110-113). Distinct buffers, NOT aliases: the jitted
             # step donates the whole state, and donating one buffer through
-            # several tree slots is an error.
-            ema = {r: jax.tree_util.tree_map(jnp.copy, params)
-                   for r in self.ema_rates}
+            # several tree slots is an error. Under ZeRO-1 the copies land
+            # directly in the data-sharded layout (one compiled copy fn,
+            # reused per rate).
+            if self.shard_optimizer:
+                copy_to_z = jax.jit(
+                    lambda p: jax.tree_util.tree_map(jnp.copy, p),
+                    out_shardings=zshard)
+                ema = {r: copy_to_z(params) for r in self.ema_rates}
+            else:
+                ema = {r: jax.tree_util.tree_map(jnp.copy, params)
+                       for r in self.ema_rates}
 
         self.n_params = wl.param_count(params)
         self.step = 0
@@ -403,6 +453,13 @@ class TrainLoop:
                 abstract_params=_abstract_like(params),
                 ema_rates=self.ema_rates,
                 abstract_opt=_abstract_like(opt_state),
+                # EMA restore target: under ZeRO-1 the EMA layout differs
+                # from the params layout (data-sharded), and a degraded
+                # (missing/corrupt) companion must land in it too — the
+                # AOT step's pinned shardings reject a params-layout EMA
+                # at the second step.
+                abstract_ema=(_abstract_like(next(iter(ema.values())))
+                              if ema else None),
                 explicit_model_path=resume_checkpoint,
             )
         self.resumed_from = ""
@@ -554,7 +611,7 @@ class TrainLoop:
         rep = replicated(self.mesh)
         state_shard = TrainState(step=rep, params=pshard,
                                  opt_state=self._oshard,
-                                 ema={r: pshard for r in rates})
+                                 ema={r: self._zshard for r in rates})
         self._train_step = AOTStep(
             jax.jit(train_step, donate_argnums=(0,),
                     out_shardings=(state_shard, rep)), "train_step",
@@ -827,6 +884,23 @@ class TrainLoop:
         except OSError as e:
             logger.warn(f"goodput record write failed: {e}")
 
+    def footprint(self) -> Dict[str, int]:
+        """HBM/params footprint gauges (ISSUE 9): logical state bytes plus
+        the per-replica (one device's addressable shard) bytes — the
+        number ZeRO-1 exists to shrink — and the backend's peak live
+        allocation (0 where the backend doesn't report memory stats, e.g.
+        CPU). Logged every log window and carried on bench train rows."""
+        s = self.state
+        return {
+            "params_bytes": tree_bytes(s.params),
+            "params_bytes_per_replica": tree_bytes_per_replica(s.params),
+            "opt_state_bytes": tree_bytes(s.opt_state),
+            "opt_state_bytes_per_replica":
+                tree_bytes_per_replica(s.opt_state),
+            "ema_bytes_per_replica": tree_bytes_per_replica(s.ema),
+            "peak_live_bytes": peak_live_bytes(),
+        }
+
     def _log_throughput(self) -> None:
         sps, tps = self._timer.lap()
         if tps > 0:
@@ -844,6 +918,10 @@ class TrainLoop:
         # wall so far) rides the same cadence: a run bleeding time to
         # restarts/stalls shows it here long before the bench does.
         logger.logkv("goodput", round(self.goodput_summary()["goodput"], 4))
+        # Memory footprint: params/opt-state bytes (per-replica is the
+        # ZeRO-1 acceptance gauge) + backend peak live bytes.
+        for gauge, b in self.footprint().items():
+            logger.logkv(gauge, b)
 
     def _maybe_profile(self, loop_step: int) -> None:
         """Start/stop the jax.profiler trace window (steps counted from loop

@@ -27,12 +27,13 @@ def codes(findings):
 # ------------------------------------------------------------ rule catalog
 
 
-def test_catalog_has_all_seven_rules():
+def test_catalog_has_all_rules():
     got = {r.code for r in all_rules()}
     for expected in ("GL001-key-reuse", "GL002-host-sync",
                      "GL003-donation-after-use", "GL004-impure-jit",
                      "GL005-recompile-hazard", "GL006-raw-shard-map",
-                     "GL007-host-sync-in-loop"):
+                     "GL007-host-sync-in-loop",
+                     "GL008-hand-wired-sharding"):
         assert expected in got
 
 
@@ -390,6 +391,70 @@ def test_host_sync_in_traced_loop_is_gl002_territory(tmp_path):
             return x
     """)
     assert "GL007-host-sync-in-loop" not in codes(fs)
+
+
+# ------------------------------------------------------------------- GL008
+
+
+def test_named_sharding_outside_engine_flagged(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        def place(mesh, x):
+            return jax.device_put(x, NamedSharding(mesh, P("data")))
+    """)
+    assert "GL008-hand-wired-sharding" in codes(fs)
+
+
+def test_partition_spec_as_sharding_kwarg_flagged(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def build(f):
+            return jax.jit(f, out_shardings=P("data"))
+    """)
+    assert "GL008-hand-wired-sharding" in codes(fs)
+
+
+def test_partition_spec_into_constraint_and_device_kwarg_flagged(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        def pin(x):
+            return jax.lax.with_sharding_constraint(x, P("data"))
+        def place(x):
+            return jax.device_put(x, device=P("data"))
+    """)
+    assert sum(1 for f in fs
+               if f.rule == "GL008-hand-wired-sharding") == 2
+
+
+def test_bare_partition_spec_construction_is_clean(tmp_path):
+    """Rule tables and shard_map specs are MADE of PartitionSpecs — only
+    using one directly AS a sharding is hand-wiring."""
+    fs = lint(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+        from distributed_pipeline_tpu.utils.jax_compat import shard_map
+        RULES = ((r"attn/qkv$", P("fsdp", None)), (r".*", P()))
+        def wrap(f, mesh):
+            return shard_map(f, mesh, in_specs=(P("data"),),
+                             out_specs=P("data"))
+    """)
+    assert "GL008-hand-wired-sharding" not in codes(fs)
+
+
+def test_engine_modules_exempt_from_gl008(tmp_path):
+    src = """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        def replicated(mesh):
+            return NamedSharding(mesh, P())
+    """
+    for name in ("parallel/partition.py", "parallel/sharding.py"):
+        assert "GL008-hand-wired-sharding" not in codes(
+            lint(tmp_path, src, name=name))
+    assert "GL008-hand-wired-sharding" in codes(
+        lint(tmp_path, src, name="serving/somewhere.py"))
 
 
 # ----------------------------------------------------------- parse errors

@@ -42,9 +42,10 @@ def bench_run(tmp_path_factory):
         "BENCH_BUDGET_S": "1",
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
-        # headline + 4 satellites of the same family: enough legs to
+        # glob: the headline + its satellite twins — enough legs to
         # observe ordering and skipping without a multi-minute test
-        "BENCH_ONLY": "diffuseq-base-seq128",
+        # (BENCH_ONLY without a wildcard is an EXACT match now)
+        "BENCH_ONLY": "diffuseq-base-seq128*",
     })
     # The conftest's 8-fake-device XLA_FLAGS would leak into the subprocess
     # and change the bench's dp=-1 mesh; the bench contract is about the
@@ -86,9 +87,9 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert skipped, "1s budget must skip every non-headline leg"
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
-    # (headline + prefetch A/B twin + chaos + noaccum + moe8 + moe8-cf1
-    # + scan)
-    assert len(final["configs"]) == 7
+    # (headline + prefetch A/B twin + zero1 A/B + chaos + noaccum + moe8
+    # + moe8-cf1 + scan)
+    assert len(final["configs"]) == 8
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
@@ -99,6 +100,33 @@ def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
     # the incrementally-persisted artifact IS the final configs list — a
     # timeout after leg k would still have left rows 0..k on disk
     assert rows == final["configs"]
+
+
+def test_bench_only_exact_match_with_optional_glob():
+    """BENCH_ONLY leg selection (ISSUE 9 satellite): a bare name is an
+    EXACT match — the old substring filter made
+    BENCH_ONLY=diffuseq-base-seq128 run SEVEN legs, the chaos leg
+    included — and a wildcard pattern is an fnmatch glob."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    legs = [(n, None) for n in (
+        "diffuseq-base-seq128", "diffuseq-base-seq128-prefetch",
+        "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
+        "gpt2-serve-decode-b64", "gpt2-base-decode-oneshot-b1")]
+    names = lambda got: [n for n, _ in got]
+    assert names(bench.select_legs(legs, "diffuseq-base-seq128")) == \
+        ["diffuseq-base-seq128"]
+    assert names(bench.select_legs(legs, "diffuseq-base-seq128*")) == \
+        ["diffuseq-base-seq128", "diffuseq-base-seq128-prefetch",
+         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos"]
+    assert names(bench.select_legs(legs, "*serve-decode*")) == \
+        ["gpt2-serve-decode-b64"]
+    assert bench.select_legs(legs, "") == legs
+    assert bench.select_legs(legs, "no-such-leg") == []
 
 
 # ----------------------------------------------------- serving decode legs
@@ -115,7 +143,7 @@ def serve_bench_run(tmp_path_factory):
         "BENCH_BUDGET_S": "240",
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
-        "BENCH_ONLY": "serve-decode",
+        "BENCH_ONLY": "*serve-decode*",
     })
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(

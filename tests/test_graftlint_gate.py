@@ -29,6 +29,9 @@ GATED_PATHS = [
     # the chaos tests drive TrainLoop outer loops + fault hooks (chaos/
     # itself rides the package walk above)
     os.path.join(ROOT, "tests", "test_chaos.py"),
+    # the partition/ZeRO-1 tests drive TrainLoop outer loops AND handle
+    # shardings directly — both GL007 and GL008 territory
+    os.path.join(ROOT, "tests", "test_partition.py"),
 ]
 
 
