@@ -471,6 +471,86 @@ def main() -> None:
             "recompile_count": steady_recompiles,
         }
 
+    def _run_supervised_ring(run_dir_name: str, plan: dict, ring_args,
+                             *, timeout_s: float = 230.0, extra_env=None):
+        """Shared scaffolding for the chaos/elastic robustness legs: a
+        supervised run.train ring in its OWN SESSION (timeout killpg's
+        the whole tree — killing only the launcher would orphan its
+        worker, leaving it to burn the box and hold the run dir for
+        later rounds) against a fresh run dir, with the fault plan in
+        the env and the bench's persistent compile cache shared across
+        attempts AND rounds (resumed attempts pay a cache lookup, not an
+        XLA compile — the recompile_count==0 acceptances ride on it).
+        Returns (run_dir, rc, wall_s, output_tail); rc None on timeout."""
+        import shutil
+        import subprocess
+
+        run_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", run_dir_name))
+        shutil.rmtree(run_dir, ignore_errors=True)
+        env = dict(os.environ)
+        env.update({"DPT_CHAOS_PLAN": json.dumps(plan),
+                    "JAX_PLATFORMS": "cpu"})
+        env.update(extra_env or {})
+        # the ring workers size their own fake-device count
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        cmd = [sys.executable, "-m", "distributed_pipeline_tpu.run.train",
+               "--distributed", "--nprocs", "1", *ring_args,
+               "--compilation_cache_dir", cache_dir or "auto",
+               "--checkpoint_path", run_dir]
+        t0 = time.perf_counter()
+        ring = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            ring_out, ring_err = ring.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(ring.pid, signal.SIGKILL)
+            except OSError:
+                pass  # the group died between expiry and the kill
+            ring.wait()
+            return run_dir, None, time.perf_counter() - t0, ""
+        return (run_dir, ring.returncode, time.perf_counter() - t0,
+                (ring_err or ring_out or "")[-300:])
+
+    def _resumed_steady_recompiles(run_dir: str, per_attempt) -> int:
+        """Max steady-state recompile count over RESUMED attempts, from
+        the clean-exit sidecars (preferred) or the post-mortem beacon
+        snapshots in attempts.jsonl."""
+        from distributed_pipeline_tpu.chaos import read_goodput_records
+
+        sidecars = read_goodput_records(run_dir)
+        worst = 0
+        for rec in per_attempt:
+            a = int(rec.get("attempt", 0))
+            if a == 0:
+                continue
+            src = sidecars.get(a) or rec
+            c = src.get("steady_recompile_count")
+            if c is not None:
+                worst = max(worst, int(c))
+        return worst
+
+    def _tiny_ring_train_args(steps: int, save_interval: int, batch: int,
+                              hidden: int, layers: int,
+                              max_restarts: int, backoff_s: float):
+        """The CPU smoke training shape the robustness legs share: they
+        measure the recovery stack, not the chip."""
+        return ["--max_restarts", str(max_restarts),
+                "--restart_backoff_s", str(backoff_s),
+                "--batch_size", str(batch), "--microbatch", str(batch // 2),
+                "--seq_len", "64", "--vocab_size", "64",
+                "--hidden_size", str(hidden), "--num_layers", str(layers),
+                "--num_heads", "2", "--diffusion_steps", "50",
+                "--dtype", "float32", "--ema_rate", "0.9",
+                "--learning_steps", str(steps),
+                "--save_interval", str(save_interval),
+                "--eval_interval", "1000000", "--log_interval", "1000000",
+                "--sanitize", "true"]
+
     def measure_chaos(name: str, *, steps: int, save_interval: int,
                       kill_step: int, crash_save_step: int,
                       batch: int = 8, hidden: int = 64, layers: int = 2,
@@ -490,87 +570,31 @@ def main() -> None:
         STEADY-state compile count over resumed attempts: with the
         persistent compile cache warm, a resumed attempt must not
         recompile after its first step."""
-        import shutil
-        import subprocess
+        from distributed_pipeline_tpu.chaos import aggregate_run
 
-        from distributed_pipeline_tpu.chaos import (aggregate_run,
-                                                    read_goodput_records)
-
-        run_dir = os.path.abspath(
-            os.path.join("model_checkpoints", "bench", "chaos_run"))
-        shutil.rmtree(run_dir, ignore_errors=True)
         plan = {"faults": [
             {"kind": "kill", "step": kill_step, "rank": 0,
              "sig": "SIGKILL"},
             {"kind": "crash_in_save", "step": crash_save_step, "rank": 0},
         ]}
-        env = dict(os.environ)
-        env.update({"DPT_CHAOS_PLAN": json.dumps(plan),
-                    "JAX_PLATFORMS": "cpu"})
-        # the ring workers size their own fake-device count
-        env.pop("XLA_FLAGS", None)
-        env.pop("JAX_COMPILATION_CACHE_DIR", None)
-        cmd = [sys.executable, "-m", "distributed_pipeline_tpu.run.train",
-               "--distributed", "--nprocs", "1",
-               "--max_restarts", str(max_restarts),
-               "--restart_backoff_s", str(backoff_s),
-               "--batch_size", str(batch), "--microbatch", str(batch // 2),
-               "--seq_len", "64", "--vocab_size", "64",
-               "--hidden_size", str(hidden), "--num_layers", str(layers),
-               "--num_heads", "2", "--diffusion_steps", "50",
-               "--dtype", "float32", "--ema_rate", "0.9",
-               "--learning_steps", str(steps),
-               "--save_interval", str(save_interval),
-               "--eval_interval", "1000000", "--log_interval", "1000000",
-               "--sanitize", "true",
-               # the bench's persistent compile cache, shared across
-               # attempts AND bench rounds: resumed attempts (and repeat
-               # runs) pay a cache lookup, not an XLA compile — the
-               # recompile_count==0 acceptance rides on it ('auto' would
-               # also warm attempts 1+, via the run dir, just not rounds)
-               "--compilation_cache_dir", cache_dir or "auto",
-               "--checkpoint_path", run_dir]
-        t0 = time.perf_counter()
-        # Own timeout UNDER the leg's SIGALRM cap, and the ring runs in
-        # its OWN SESSION so expiry can killpg the whole tree — killing
-        # only the launcher would orphan the worker it spawned, leaving
-        # it to burn the box and hold the run dir for later rounds.
-        ring = subprocess.Popen(
-            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True, start_new_session=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        try:
-            ring_out, ring_err = ring.communicate(timeout=230)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(ring.pid, signal.SIGKILL)
-            except OSError:
-                pass  # the group died between expiry and the kill
-            ring.wait()
+        # Own timeout UNDER the leg's SIGALRM cap (see
+        # _run_supervised_ring for the session/killpg rationale).
+        run_dir, rc, wall, tail = _run_supervised_ring(
+            "chaos_run", plan,
+            _tiny_ring_train_args(steps, save_interval, batch, hidden,
+                                  layers, max_restarts, backoff_s))
+        if rc is None:
             return {"name": name,
                     "error": "chaos ring exceeded its 230s timeout"}
-        wall = time.perf_counter() - t0
         agg = aggregate_run(run_dir)
         completed = os.path.isdir(
             os.path.join(run_dir, f"model_{steps:06d}"))
-        # max steady-state recompile count over RESUMED attempts, from the
-        # clean-exit sidecars (preferred) or the post-mortem beacon
-        # snapshots in attempts.jsonl
-        sidecars = read_goodput_records(run_dir)
-        resumed_recompiles = 0
-        for rec in agg["per_attempt"]:
-            a = int(rec.get("attempt", 0))
-            if a == 0:
-                continue
-            src = sidecars.get(a) or rec
-            c = src.get("steady_recompile_count")
-            if c is not None:
-                resumed_recompiles = max(resumed_recompiles, int(c))
+        resumed_recompiles = _resumed_steady_recompiles(
+            run_dir, agg["per_attempt"])
         if not completed:
-            tail = (ring_err or ring_out or "")[-300:]
             return {"name": name,
                     "error": f"chaos run did not reach step {steps} "
-                             f"(rc={ring.returncode}): {tail}"}
+                             f"(rc={rc}): {tail}"}
         return {
             "name": name,
             "completed": True,
@@ -583,12 +607,92 @@ def main() -> None:
             "save_s": round(agg["save_s"], 2),
             "data_stall_s": round(agg["data_stall_s"], 2),
             "recompute_s": round(agg["recompute_s"], 2),
+            "hang_s": round(agg["hang_s"], 2),
             "lost_s": round(agg["lost_s"], 2),
             "downtime_s": round(agg["downtime_s"], 2),
             "wall_s": round(agg["wall_s"], 2),
             "accounted_frac": round(agg["accounted_frac"], 4),
             "attempts": agg["attempts"],
             "injected_faults": len(plan["faults"]),
+            "recompile_count": resumed_recompiles,
+            "steps": steps, "batch": batch,
+            "leg_wall_s": round(wall, 1),
+        }
+
+    def measure_elastic(name: str, *, steps: int, save_interval: int,
+                        stall_step_at: int, hang_timeout_s: float = 2.0,
+                        batch: int = 16, hidden: int = 64, layers: int = 2,
+                        max_restarts: int = 3, backoff_s: float = 0.2,
+                        devices_schedule: str = "2,1"):
+        """Elastic-topology + hang-watchdog leg (ISSUE 10): a SUPERVISED
+        ring that must survive the two failures r10's chaos leg cannot
+        model — a worker that WEDGES without dying (``stall_step``: the
+        watchdog must detect the frozen beacons and SIGKILL the ring
+        within ``hang_timeout_s`` + poll grace) and a SHRUNK restart
+        (the ``DPT_FORCE_DEVICES_PER_PROC`` schedule drops the ring from
+        2 fake devices to 1 between attempts: dp=2 -> dp=1, so the
+        resume reshards params/opt/EMA onto the smaller mesh). The run
+        must still complete to the target step; headline numbers are
+        GOODPUT (>= 0.6 acceptance — one bounded hang + one reshape
+        restart must not eat the run) with ``accounted_frac == 1.0``
+        including the new ``hang`` category, the measured watchdog kill
+        latency, and zero steady-state recompiles on resumed attempts
+        (each topology compiles once; the cache makes repeats free)."""
+        from distributed_pipeline_tpu.chaos import (aggregate_run,
+                                                    read_attempts)
+
+        plan = {"faults": [
+            {"kind": "stall_step", "step": stall_step_at, "rank": 0,
+             "seconds": 600},
+        ]}
+        run_dir, rc, wall, tail = _run_supervised_ring(
+            "elastic_run", plan,
+            _tiny_ring_train_args(steps, save_interval, batch, hidden,
+                                  layers, max_restarts, backoff_s)
+            + ["--hang_timeout_s", str(hang_timeout_s)],
+            extra_env={"DPT_FORCE_DEVICES_PER_PROC": devices_schedule})
+        if rc is None:
+            return {"name": name,
+                    "error": "elastic ring exceeded its 230s timeout"}
+        agg = aggregate_run(run_dir)
+        recs = read_attempts(run_dir)
+        completed = os.path.isdir(
+            os.path.join(run_dir, f"model_{steps:06d}"))
+        hung = [r for r in recs if r.get("hung")]
+        resumed_recompiles = _resumed_steady_recompiles(
+            run_dir, agg["per_attempt"])
+        if not completed:
+            return {"name": name,
+                    "error": f"elastic run did not reach step {steps} "
+                             f"(rc={rc}): {tail}"}
+        if not hung:
+            return {"name": name,
+                    "error": "stall_step injected but no attempt was "
+                             "hang-killed — the watchdog never fired"}
+        topologies = [(r.get("nprocs"), r.get("devices_per_proc"))
+                      for r in recs]
+        return {
+            "name": name,
+            "completed": True,
+            "goodput": round(agg["goodput"], 4),
+            "useful_step_s": round(agg["useful_step_s"], 2),
+            "restore_s": round(agg["restore_s"], 2),
+            "compile_s": round(agg["compile_s"], 2),
+            "recompute_s": round(agg["recompute_s"], 2),
+            "hang_s": round(agg["hang_s"], 2),
+            "lost_s": round(agg["lost_s"], 2),
+            "downtime_s": round(agg["downtime_s"], 2),
+            "wall_s": round(agg["wall_s"], 2),
+            "accounted_frac": round(agg["accounted_frac"], 4),
+            "attempts": agg["attempts"],
+            "hung_attempts": len(hung),
+            # watchdog kill latency: frozen-window length the watchdog
+            # allowed before killing — the "within hang_timeout_s +
+            # grace" acceptance number
+            "watchdog_kill_s": round(max(
+                float(r.get("hang_s") or 0.0) for r in hung), 2),
+            "hang_timeout_s": hang_timeout_s,
+            "topologies": [f"{n}x{d}" for n, d in topologies],
             "recompile_count": resumed_recompiles,
             "steps": steps, "batch": batch,
             "leg_wall_s": round(wall, 1),
@@ -749,7 +853,9 @@ def main() -> None:
         return row
 
     def measure_zero1_ab(name: str, *, batch: int, microbatch: int,
-                         seq_len: int, window_steps: int, rounds: int):
+                         seq_len: int, window_steps: int, rounds: int,
+                         size: str = "base", cpu_hidden: int = 256,
+                         cpu_layers: int = 2, timeout_s: float = 200.0):
         """ZeRO-1 A/B leg (ISSUE 9): paired interleaved shard_optimizer
         ON/OFF at the headline shape on a >= 2-way data axis, run in a
         CHILD PROCESS (run/zero1_ab.py) so the CPU smoke box — one real
@@ -760,11 +866,16 @@ def main() -> None:
         inside the box noise band (steps/s parity — ZeRO-1 trades a
         per-step update all-gather for dp x less weight-update memory)
         and ``steady_recompile_count`` == 0 (pinned out_shardings: the
-        sharded layout compiles exactly once)."""
+        sharded layout compiles exactly once).
+
+        ``size`` selects the preset — the xl leg (ISSUE 10 satellite)
+        runs the SAME protocol at the xl shape the ZeRO-1 headroom
+        exists for; a child that dies (HBM OOM at xl with two live
+        loops) comes back as an error row, never an abort."""
         import subprocess
 
         env = dict(os.environ)
-        args = ["--family", "diffuseq", "--size", "base",
+        args = ["--family", "diffuseq", "--size", size,
                 "--batch", str(batch), "--microbatch", str(microbatch),
                 "--seq_len", str(seq_len), "--dtype", dtype,
                 "--window_steps", str(window_steps),
@@ -778,18 +889,22 @@ def main() -> None:
             # cost on CPU, so the step must carry enough matmul for the
             # parity contract to be measurable (at hidden 64 the op
             # overhead alone reads as -15%; at 256 the delta sits inside
-            # the +-3% noise band — measured on this box)
-            args += ["--hidden", "256", "--layers", "2", "--heads", "4",
+            # the +-3% noise band — measured on this box). The xl leg
+            # scales these up so its CPU smoke row still exercises a
+            # bigger-model shape than the base leg.
+            args += ["--hidden", str(cpu_hidden),
+                     "--layers", str(cpu_layers), "--heads", "4",
                      "--vocab", "256"]
         try:
             proc = subprocess.run(
                 [sys.executable, "-m",
                  "distributed_pipeline_tpu.run.zero1_ab"] + args,
-                env=env, capture_output=True, text=True, timeout=200,
+                env=env, capture_output=True, text=True, timeout=timeout_s,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:
             return {"name": name,
-                    "error": "zero1 A/B child exceeded its 200s timeout"}
+                    "error": f"zero1 A/B child exceeded its "
+                             f"{timeout_s:.0f}s timeout"}
         lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
         if proc.returncode != 0 or not lines:
             tail = (proc.stderr or proc.stdout or "")[-300:]
@@ -896,6 +1011,19 @@ def main() -> None:
             measure_chaos, "diffuseq-base-seq128-chaos",
             steps=4000, save_interval=250, batch=16,
             kill_step=1600, crash_save_step=2750)),
+        # Elastic + hang-watchdog leg (ISSUE 10): the failures the chaos
+        # leg cannot model — a worker that WEDGES without exiting (the
+        # stall_step fault; the --hang_timeout_s watchdog must detect
+        # the frozen beacons and kill the ring) and a SHRUNK restart
+        # (DPT_FORCE_DEVICES_PER_PROC drops the ring dp=2 -> dp=1, so
+        # the resume reshards state onto the smaller mesh). Acceptance:
+        # completes with goodput >= 0.6, accounted_frac == 1.0 including
+        # the new hang category, watchdog kill within timeout + grace,
+        # steady recompiles 0 on resumed attempts.
+        ("diffuseq-base-seq128-elastic", functools.partial(
+            measure_elastic, "diffuseq-base-seq128-elastic",
+            steps=3000, save_interval=250, stall_step_at=1400,
+            hang_timeout_s=2.0, batch=16)),
         # no-accumulation variant (pure config-2 semantics)
         ("diffuseq-base-seq128-noaccum", functools.partial(
             measure, "diffuseq-base-seq128-noaccum", family="diffuseq",
@@ -978,6 +1106,20 @@ def main() -> None:
             gen_tokens=128 if on_tpu else 8,
             batch=8 if on_tpu else 2,
             seq_len=1024 if on_tpu else 64)),
+        # First xl-preset leg (ISSUE 10 satellite, CHANGES r11 note):
+        # ZeRO-1's per-replica headroom is what makes the xl shape fit a
+        # chip at all, so it runs the zero1 A/B protocol at model_size
+        # xl. Last in the order and budget-capped like every leg — an
+        # OOM or overrun becomes an error row, never a blocked headline.
+        # (CPU smoke scales the child dims up vs the base leg so the row
+        # still exercises a bigger shape.)
+        ("diffuseq-xl-seq128-zero1", functools.partial(
+            measure_zero1_ab, "diffuseq-xl-seq128-zero1", size="xl",
+            batch=64 if on_tpu else 8,
+            microbatch=16 if on_tpu else 8, seq_len=128,
+            window_steps=8 if on_tpu else 4,
+            rounds=4 if on_tpu else 6,
+            cpu_hidden=320, cpu_layers=3, timeout_s=220.0)),
     ]
 
     only = os.environ.get("BENCH_ONLY", "")

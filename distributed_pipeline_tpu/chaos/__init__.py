@@ -4,7 +4,8 @@ The recovery machinery — the self-relaunching launcher, checkpoint
 auto-resume, the persistent compile cache — is only as real as the failures
 it has survived. This package supplies the failures (:class:`ChaosPlan` /
 :class:`ChaosInjector`: scheduled kills, crashes mid-checkpoint-save, data
-stalls, corrupted checkpoints) and the metric that proves survival was
+stalls, step-loop wedges, stragglers, corrupted checkpoints) and the
+metric that proves survival was
 cheap (:mod:`.goodput`: useful-step time / wall time, with every second of
 a restarted run attributed to a category).
 

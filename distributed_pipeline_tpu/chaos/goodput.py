@@ -17,9 +17,14 @@ Three artifact kinds live in the run dir, written by different parties:
 :func:`aggregate_run` folds all three into one decomposition::
 
     wall ≈ useful + startup + restore + compile + save + data_stall
-           + recompute + lost + downtime
+           + recompute + hang + lost + downtime
 
 with ``goodput = useful / wall`` — the bench's acceptance metric.
+``hang`` is LAUNCHER-attributed (the attempt record's ``hang_s``): the
+window between an attempt's last observed progress and the hang
+watchdog killing it — time a silently wedged worker burned while still
+"alive". Without the watchdog that window is unbounded; with it, it is
+measured and bounded by ``--hang_timeout_s``.
 
 Import-light (no jax): the launcher reads and writes these artifacts
 before/after worker processes exist.
@@ -34,7 +39,7 @@ import re
 from typing import Any, Dict, List, Optional
 
 __all__ = [
-    "beacon_path", "read_beacons", "beacon_max_step",
+    "beacon_path", "read_beacons", "beacon_max_step", "beacon_mtimes",
     "attempts_path", "append_attempt", "read_attempts",
     "goodput_record_path", "read_goodput_records", "aggregate_run",
 ]
@@ -66,6 +71,21 @@ def read_beacons(run_dir: str) -> Dict[int, dict]:
         payload = _read_json(path) if m else None
         if m and isinstance(payload, dict):
             out[int(m.group(1))] = payload
+    return out
+
+
+def beacon_mtimes(run_dir: str) -> Dict[str, float]:
+    """mtime per beacon file — the launcher hang watchdog's liveness
+    signal (the trainer atomically replaces each rank's beacon every
+    step, so a frozen newest-mtime means NO rank is advancing). Lives
+    here so the beacon naming has exactly one owner; a beacon caught
+    mid-replace is skipped and picked up next poll."""
+    out: Dict[str, float] = {}
+    for path in glob.glob(os.path.join(run_dir, ".progress_rank*.json")):
+        try:
+            out[path] = os.stat(path).st_mtime
+        except OSError:
+            pass
     return out
 
 
@@ -115,6 +135,19 @@ def read_goodput_records(run_dir: str) -> Dict[int, dict]:
     return out
 
 
+def _fnum(x: Any, default: float = 0.0) -> float:
+    """Defensive number coercion for fields read off disk: a killed
+    attempt's artifacts may carry ``null`` (a beacon snapshotted mid-
+    build, a record harvested with no beacon at all) or garbage from a
+    torn write — the fold must degrade that attempt, never raise."""
+    try:
+        if isinstance(x, bool) or x is None:
+            return default
+        return float(x)
+    except (TypeError, ValueError):
+        return default
+
+
 def aggregate_run(run_dir: str) -> Dict[str, Any]:
     """Fold a run's attempts into one goodput decomposition.
 
@@ -122,51 +155,72 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
     exists, else the launcher's post-mortem beacon snapshot (a killed
     attempt's flight recorder). Attempt wall not covered by either —
     including whole attempts that died before their first beacon — lands
-    in ``lost_s``: genuinely thrown-away time. ``downtime_s`` is the
-    launcher-observed gap between attempts (teardown + backoff + spawn).
+    in ``lost_s``: genuinely thrown-away time, EXCEPT the watchdog-
+    measured ``hang_s`` window, which gets its own category (a wedge the
+    watchdog bounded is a different failure than unaccounted loss).
+    ``downtime_s`` is the launcher-observed gap between attempts
+    (teardown + backoff + spawn).
+
+    Degrades, never raises: a hard-killed attempt with a missing or
+    zero-byte sidecar/beacon, or one whose snapshot carries nulls, folds
+    as ``lost`` time — ``accounted_frac`` stays 1.0 by construction.
     """
     attempts = read_attempts(run_dir)
     sidecars = read_goodput_records(run_dir)
     cats = {c: 0.0 for c in _CATEGORIES}
-    useful = lost = downtime = 0.0
+    useful = lost = downtime = hang = 0.0
     per_attempt: List[dict] = []
 
-    def _fold(idx: int, duration_s: Optional[float], gp: Optional[dict]):
-        nonlocal useful, lost
+    def _fold(idx: int, duration_s: Optional[float], gp: Optional[dict],
+              hang_s: float = 0.0):
+        nonlocal useful, lost, hang
+        hang += hang_s
+        if not isinstance(gp, dict):
+            gp = None  # a non-dict snapshot (torn write) is no snapshot
         if gp:
             for c in _CATEGORIES:
-                cats[c] += float(gp.get(c, 0.0))
-            useful += float(gp.get("useful_step_s", 0.0))
+                cats[c] += _fnum(gp.get(c))
+            useful += _fnum(gp.get("useful_step_s"))
             if duration_s is not None:
-                lost += max(0.0, duration_s - float(gp.get("wall_s", 0.0)))
+                lost += max(0.0, duration_s - _fnum(gp.get("wall_s"))
+                            - hang_s)
         elif duration_s is not None:
-            lost += duration_s
+            lost += max(0.0, duration_s - hang_s)
 
     if attempts:
         for rec in attempts:
-            idx = int(rec.get("attempt", 0))
+            idx = int(_fnum(rec.get("attempt")))
             gp = sidecars.get(idx) or rec.get("goodput") or None
-            downtime += float(rec.get("downtime_s", 0.0))
-            _fold(idx, float(rec.get("duration_s", 0.0)), gp)
+            downtime += _fnum(rec.get("downtime_s"))
+            dur = rec.get("duration_s")
+            if isinstance(dur, bool) or not isinstance(dur, (int, float)):
+                # null/garbled duration (torn record): re-derive from the
+                # spawn/exit stamps so the attempt's wall degrades to
+                # lost instead of silently vanishing from the fold
+                dur = max(0.0, _fnum(rec.get("t_exit"))
+                          - _fnum(rec.get("t_spawn")))
+            _fold(idx, float(dur), gp, hang_s=_fnum(rec.get("hang_s")))
             per_attempt.append({**rec,
                                 "goodput_source": ("sidecar" if idx in sidecars
-                                                   else "beacon" if gp
+                                                   else "beacon"
+                                                   if isinstance(gp, dict)
                                                    else None)})
-        wall = (float(attempts[-1].get("t_exit", 0.0))
-                - float(attempts[0].get("t_spawn", 0.0)))
+        wall = (_fnum(attempts[-1].get("t_exit"))
+                - _fnum(attempts[0].get("t_spawn")))
     else:
         # Launcher-less run (single process): the sidecars are all there is.
         for idx in sorted(sidecars):
             _fold(idx, None, sidecars[idx])
             per_attempt.append({"attempt": idx, "goodput_source": "sidecar"})
-        wall = sum(float(s.get("wall_s", 0.0)) for s in sidecars.values())
+        wall = sum(_fnum(s.get("wall_s")) for s in sidecars.values())
     wall = max(wall, 1e-9)
-    accounted = useful + sum(cats.values()) + lost + downtime
+    accounted = useful + sum(cats.values()) + hang + lost + downtime
     return {
         "wall_s": wall,
         "useful_step_s": useful,
         "goodput": useful / wall,
         **cats,
+        "hang_s": hang,
         "lost_s": lost,
         "downtime_s": downtime,
         "accounted_s": accounted,

@@ -129,19 +129,40 @@ class ChaosInjector:
         # returns here. Either way the fault's job is done.
 
     def on_step(self, loop) -> None:
-        """Top of ``run_step``: corrupt/kill faults scheduled for the step
-        ABOUT to run (plan order — corrupt-then-kill at the same step is
-        the classic 'newest checkpoint is garbage AND the worker died')."""
+        """Top of ``run_step``: corrupt/kill/stall_step faults scheduled
+        for the step ABOUT to run (plan order — corrupt-then-kill at the
+        same step is the classic 'newest checkpoint is garbage AND the
+        worker died'), plus the slow_rank straggler delay."""
         for idx, fault in self._due(loop.step,
-                                    ("corrupt_checkpoint", "kill")):
+                                    ("corrupt_checkpoint", "kill",
+                                     "stall_step")):
             self._mark_fired(idx, fault)
             if fault.kind == "corrupt_checkpoint":
                 victim = corrupt_newest_checkpoint(
                     self.run_dir or loop.checkpoint_dir)
                 print(f"[chaos] rank {self.rank}: corrupted checkpoint "
                       f"{victim}", file=sys.stderr, flush=True)
+            elif fault.kind == "stall_step":
+                # The wedge the hang watchdog exists for: the process
+                # stays ALIVE but stops advancing — no beacon write, no
+                # exit code, nothing the restart machinery can see. The
+                # marker landed first, so the attempt the watchdog
+                # eventually kills is resumed past the wedge step.
+                print(f"[chaos] rank {self.rank}: wedging step loop "
+                      f"{fault.seconds}s at step {fault.step}",
+                      file=sys.stderr, flush=True)
+                time.sleep(fault.seconds)
             else:
                 self._fire_kill(fault)
+        # slow_rank: a straggler, not a hang — sleeps before EVERY step in
+        # its [step, until_step] range, with no once-per-run marker (it
+        # never kills; a respawned attempt re-straggles only the steps it
+        # actually replays). Beacons keep advancing, so the hang watchdog
+        # must ride through it.
+        for fault in self.plan.faults:
+            if (fault.kind == "slow_rank" and fault.rank == self.rank
+                    and fault.step <= loop.step <= fault.until_step):
+                time.sleep(fault.seconds)
 
     def on_data(self, loop) -> float:
         """Before pulling the batch for the NEXT step: stall faults.

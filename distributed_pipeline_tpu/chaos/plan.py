@@ -15,6 +15,10 @@ Schema::
         {"kind": "crash_in_save",      "step": 6, "rank": 0},
         {"kind": "stall_data",         "step": 2, "rank": 0,
          "seconds": 1.5},
+        {"kind": "stall_step",         "step": 4, "rank": 0,
+         "seconds": 600},
+        {"kind": "slow_rank",          "step": 2, "rank": 0,
+         "seconds": 0.2, "until_step": 6},
         {"kind": "corrupt_checkpoint", "step": 5, "rank": 0}
     ]}
 
@@ -27,6 +31,17 @@ Fault kinds (executed by :mod:`.inject`):
   and finalize — leaving an unfinalized/torn checkpoint on disk;
 * ``stall_data`` — the targeted rank's data iterator blocks ``seconds``
   before yielding the batch at ``step`` (a wedged input pipeline);
+* ``stall_step`` — the targeted rank WEDGES inside the step loop for
+  ``seconds`` at the top of step ``step`` (a hung collective / network
+  stall: the process stays alive but no rank advances — the failure the
+  launcher's ``--hang_timeout_s`` watchdog exists to detect, since no
+  exit code ever fires the restart machinery). Fires once per run (the
+  marker makes the respawned attempt sail past the wedge step);
+* ``slow_rank`` — a STRAGGLER, not a hang: the targeted rank sleeps
+  ``seconds`` before EVERY step in ``[step, until_step]``. Progress
+  continues (beacons keep advancing), so the hang watchdog must NOT
+  fire — the negative control proving the watchdog keys on stalled
+  progress, not on slowness;
 * ``corrupt_checkpoint`` — garbles the payload of the newest FINALIZED
   checkpoint in the run dir at ``step`` (bit rot / torn replication: the
   directory still looks committed, but restore fails — the case the
@@ -47,7 +62,8 @@ __all__ = ["ChaosFault", "ChaosPlan", "CHAOS_PLAN_ENV"]
 
 CHAOS_PLAN_ENV = "DPT_CHAOS_PLAN"
 
-_KINDS = ("kill", "crash_in_save", "stall_data", "corrupt_checkpoint")
+_KINDS = ("kill", "crash_in_save", "stall_data", "stall_step", "slow_rank",
+          "corrupt_checkpoint")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,7 +76,9 @@ class ChaosFault:
     step: int
     rank: int = 0
     sig: str = "SIGKILL"      # kill only
-    seconds: float = 1.0      # stall_data only
+    seconds: float = 1.0      # stall_data / stall_step / slow_rank
+    until_step: int = -1      # slow_rank only: last straggled step
+    #                           (defaults to ``step`` — one slow step)
 
     def __post_init__(self) -> None:
         if self.kind not in _KINDS:
@@ -68,8 +86,16 @@ class ChaosFault:
                              f"(expected one of {_KINDS})")
         if self.step < 0:
             raise ValueError(f"chaos fault step must be >= 0, got {self.step}")
-        if self.kind == "stall_data" and self.seconds <= 0:
-            raise ValueError("stall_data fault needs seconds > 0")
+        if self.kind in ("stall_data", "stall_step", "slow_rank") \
+                and self.seconds <= 0:
+            raise ValueError(f"{self.kind} fault needs seconds > 0")
+        if self.kind == "slow_rank":
+            if self.until_step < 0:
+                object.__setattr__(self, "until_step", self.step)
+            elif self.until_step < self.step:
+                raise ValueError(
+                    f"slow_rank until_step {self.until_step} precedes "
+                    f"step {self.step}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,7 +127,8 @@ class ChaosPlan:
             if not isinstance(f, dict):
                 raise ValueError(f"chaos fault #{i} must be an object")
             known = {k: f[k] for k in
-                     ("kind", "step", "rank", "sig", "seconds") if k in f}
+                     ("kind", "step", "rank", "sig", "seconds",
+                      "until_step") if k in f}
             if set(f) - set(known):
                 raise ValueError(f"chaos fault #{i} has unknown keys "
                                  f"{sorted(set(f) - set(known))}")
@@ -112,7 +139,10 @@ class ChaosPlan:
         return "; ".join(
             f"{f.kind}@step{f.step}/rank{f.rank}"
             + (f" {f.sig}" if f.kind == "kill" else "")
-            + (f" {f.seconds}s" if f.kind == "stall_data" else "")
+            + (f" {f.seconds}s" if f.kind in ("stall_data", "stall_step")
+               else "")
+            + (f" {f.seconds}s/step thru {f.until_step}"
+               if f.kind == "slow_rank" else "")
             for f in self.faults)
 
     def to_json(self) -> str:

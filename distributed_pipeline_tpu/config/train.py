@@ -83,7 +83,11 @@ class GeneralSettings(S):
         "", "fault-injection schedule (chaos harness): inline JSON, "
             "@/path/to/plan.json, or a bare path — faults like "
             '{"kind": "kill", "step": N, "rank": R} / crash_in_save / '
-            "stall_data / corrupt_checkpoint fire at exact optimizer "
+            "stall_data / stall_step (wedge the step loop alive — the "
+            "hang the launcher's --hang_timeout_s watchdog detects) / "
+            "slow_rank (straggler: seconds delay per step through "
+            "until_step — must NOT trip the watchdog) / "
+            "corrupt_checkpoint fire at exact optimizer "
             "steps to prove the restart+resume stack survives them; the "
             "DPT_CHAOS_PLAN env var overrides (it reaches --config_json "
             "ring workers like DPT_PREFETCH_DEPTH does); empty disables")

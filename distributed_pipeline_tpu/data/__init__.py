@@ -47,6 +47,7 @@ __all__ = [
     "infinite_loader_from_iterable",
     "infinite_loader_from_object",
     "batch_iterator",
+    "skip_batches_for_samples",
     "prefetch_to_device",
     "DeviceBatch",
     "CustomDataset",
@@ -54,6 +55,28 @@ __all__ = [
     "SyntheticLMDataset",
     "SyntheticSeq2SeqDataset",
 ]
+
+
+def skip_batches_for_samples(consumed_samples: int, batch_size: int,
+                             process_count: int = 1) -> int:
+    """Elastic-resume fast-forward: ``skip_batches`` for a stream that must
+    land AFTER ``consumed_samples`` globally-consumed examples.
+
+    Across a topology change the unit "steps" stops meaning anything —
+    a checkpoint written at global batch 2B and resumed at global batch B
+    must skip TWICE the saved step count of the new stream's batches to
+    keep the sample sequence aligned. Global samples consumed
+    (``step * global_batch`` at save time, recorded in the checkpoint's
+    meta sidecar) is the topology-invariant position. Same topology
+    degenerates to ``skip == resume_step`` exactly, preserving the
+    bit-identical same-shape resume; when the new global batch does not
+    divide the consumed count the position rounds DOWN (a partial
+    batch's samples are re-consumed — the loss-continuity, not
+    bit-identity, contract of a shrink/grow resume)."""
+    gb = batch_size * max(process_count, 1)
+    if gb <= 0:
+        raise ValueError(f"global batch must be positive, got {gb}")
+    return max(0, int(consumed_samples)) // gb
 
 
 def infinite_loader_from_object(obj: Iterable) -> Iterator:

@@ -22,11 +22,12 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import subprocess
 import sys
 import tempfile
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..chaos import goodput as goodput_lib
 from .dist import AUTORUN_ENV_FLAG, find_free_port, is_available
@@ -37,6 +38,9 @@ __all__ = [
     "run_argv_as_distributed",
     "parse_and_autorun",
     "get_main_modname",
+    "parse_capacity_schedule",
+    "FORCE_NPROCS_ENV",
+    "FORCE_DEVICES_ENV",
 ]
 
 
@@ -85,6 +89,23 @@ def create_distributed_parser() -> argparse.ArgumentParser:
                    help="seconds between worker liveness polls (reference "
                         "dist_run.py:130-136; default is snappier than "
                         "torchrun's 5s — these are local dev workers)")
+    p.add_argument("--hang_timeout_s", type=float, default=0.0,
+                   help="hang watchdog: kill the worker ring when NO rank's "
+                        "progress beacon advances for this many seconds "
+                        "(a wedged collective / network stall never exits, "
+                        "so liveness polling alone would burn wall time "
+                        "forever); the killed window books as 'hang' in the "
+                        "goodput fold and the normal restart machinery "
+                        "resumes from the last checkpoint. Arms after the "
+                        "attempt's FIRST beacon (startup/compile time is "
+                        "not a hang); must exceed the slowest legitimate "
+                        "step+save interval. 0 disables")
+    p.add_argument("--hang_startup_timeout_s", type=float, default=0.0,
+                   help="optional pre-first-beacon watchdog: kill an "
+                        "attempt that produced NO beacon at all within this "
+                        "many seconds of spawn (a worker wedged during "
+                        "init/restore). Size it above worst-case "
+                        "interpreter+compile+restore startup. 0 disables")
     p.add_argument("--log_dir", default="",
                    help="capture each spawned worker's stdout+stderr to "
                         "{log_dir}/worker_{i}.log (torchrun --log_dir/-r "
@@ -113,7 +134,8 @@ def parse_distributed_args(
               "[--process_id I] [--nprocs N] [--devices_per_proc K] "
               "[--max_restarts R] [--restart_window_s S] "
               "[--restart_backoff_s S] [--restart_backoff_max_s S] "
-              "[--monitor_interval S] [--log_dir DIR] [--log_tee]")
+              "[--monitor_interval S] [--hang_timeout_s S] "
+              "[--hang_startup_timeout_s S] [--log_dir DIR] [--log_tee]")
     if epilog not in (parser.epilog or ""):
         parser.epilog = ((parser.epilog or "") + "\n\n" + epilog)
     return dist_ns, rest
@@ -200,18 +222,89 @@ def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
     return env
 
 
+# Per-attempt capacity override schedules (elastic-topology simulation):
+# comma-separated ints indexed by attempt, clamped to the last entry —
+# "2,1" means attempt 0 gets 2, every later attempt gets 1. On a real
+# fleet, surviving capacity comes from the scheduler/instance metadata;
+# on this box's single-host dev rings the env IS the capacity probe, so
+# shrink/grow restarts are reproducible in tests and bench legs.
+FORCE_NPROCS_ENV = "DPT_FORCE_NPROCS"
+FORCE_DEVICES_ENV = "DPT_FORCE_DEVICES_PER_PROC"
+
+
+def parse_capacity_schedule(text: str) -> Optional[List[int]]:
+    """``"2,1"`` -> [2, 1]; empty/unset -> None. Raises on malformed or
+    non-positive entries — a silently-ignored capacity override would run
+    the wrong topology without anyone noticing."""
+    if not text:
+        return None
+    out = []
+    for tok in text.split(","):
+        tok = tok.strip()
+        if not tok.isdigit() or int(tok) < 1:
+            raise ValueError(
+                f"capacity schedule entries must be positive ints, got "
+                f"{tok!r} in {text!r}")
+        out.append(int(tok))
+    return out
+
+
+def _capacity_at(schedule: Optional[List[int]], attempt: int,
+                 default: int) -> int:
+    if not schedule:
+        return default
+    return schedule[min(attempt, len(schedule) - 1)]
+
+
+def _beacon_mtimes(run_dir_file: str) -> Optional[Dict[str, float]]:
+    """mtime per progress beacon in the run dir named by the handshake
+    file, or None when the dir (or any beacon) isn't known yet. mtime is
+    the liveness signal: the trainer atomically replaces each rank's
+    beacon every optimizer step, so a frozen newest-mtime means NO rank
+    is advancing — the hang signature (a straggler still advances, just
+    slowly)."""
+    try:
+        with open(run_dir_file) as f:
+            run_dir = f.read().strip()
+    except OSError:
+        return None
+    if not run_dir or not os.path.isdir(run_dir):
+        return None
+    # the beacon naming (and the stat walk) is owned by chaos.goodput —
+    # one source of truth for what counts as a progress beacon
+    return goodput_lib.beacon_mtimes(run_dir) or None
+
+
 def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      monitor_interval: float,
                      run_timestamp: Optional[str] = None,
                      log_dir: str = "", log_tee: bool = False,
                      cache_dir: str = "", attempt: int = 0,
-                     extra_env: Optional[dict] = None) -> int:
+                     extra_env: Optional[dict] = None,
+                     hang_timeout_s: float = 0.0,
+                     hang_startup_timeout_s: float = 0.0,
+                     run_dir_file: str = "",
+                     status: Optional[dict] = None) -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
     would leave its siblings blocked in jax.distributed.initialize forever —
     terminate them instead (torchrun's elastic agent behavior). Returns the
     max worker exit code.
+
+    HANG WATCHDOG (``hang_timeout_s > 0``): liveness polling only catches
+    workers that EXIT; the nastiest production failures are workers that
+    wedge (a stuck collective, a network stall) and burn wall time without
+    ever dying. The per-step progress beacons double as the liveness
+    signal: once this attempt writes its first beacon the watchdog arms,
+    and if no rank's beacon advances for ``hang_timeout_s`` the whole ring
+    is SIGKILLed (every worker — the TrainLoop has no child processes, so
+    killing each pid takes the whole ring down) and supervision treats it
+    like any other dead attempt: restart, resume from the last checkpoint.
+    ``status`` (a caller-provided dict) receives ``hung``/``hang_s``/
+    ``hang_kind`` so the attempt record can book the wasted window to the
+    ``hang`` goodput category. ``hang_startup_timeout_s`` optionally also
+    bounds the pre-first-beacon window (a worker wedged in init/restore).
     """
     port = find_free_port()
     coord = f"127.0.0.1:{port}"
@@ -261,6 +354,16 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
             else:
                 procs.append(subprocess.Popen(cmd_base, env=env))
         codes = [None] * len(procs)
+        # Hang-watchdog state: armed by this attempt's first beacon write
+        # (beacon mtime >= spawn wall-clock — earlier attempts' stale
+        # beacons never arm it), re-anchored by every later advance.
+        t_spawn_wall = time.time()
+        t_start = time.monotonic()
+        hang_armed = False
+        last_advance = t_start
+        last_max_mtime = 0.0
+        next_hang_poll = 0.0
+        watch = hang_timeout_s > 0 or hang_startup_timeout_s > 0
         while any(c is None for c in codes):
             for i, p in enumerate(procs):
                 if codes[i] is None:
@@ -280,6 +383,45 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                             p.kill()
                             codes[i] = p.wait()
                 break
+            now = time.monotonic()
+            if watch and run_dir_file and now >= next_hang_poll:
+                # beacon stat()s are cheap but not free: throttle the
+                # probe independently of the (snappier) liveness poll
+                next_hang_poll = now + max(monitor_interval, 0.1)
+                mtimes = _beacon_mtimes(run_dir_file)
+                mx = max(mtimes.values()) if mtimes else 0.0
+                if mx > last_max_mtime:
+                    last_max_mtime = mx
+                    if mx >= t_spawn_wall - 1e-3:  # THIS attempt's write
+                        hang_armed = True
+                        last_advance = now
+                hung_kind = ""
+                if hang_armed and hang_timeout_s > 0 \
+                        and now - last_advance > hang_timeout_s:
+                    hung_kind = "stall"
+                elif not hang_armed and hang_startup_timeout_s > 0 \
+                        and now - t_start > hang_startup_timeout_s:
+                    hung_kind = "startup"
+                if hung_kind:
+                    hang_s = now - (last_advance if hang_armed else t_start)
+                    print(f"[launcher] hang watchdog: no rank advanced for "
+                          f"{hang_s:.1f}s "
+                          f"({'no first beacon' if hung_kind == 'startup' else 'beacons frozen'}); "
+                          f"SIGKILLing the worker ring")
+                    if status is not None:
+                        status.update({"hung": True,
+                                       "hang_s": round(hang_s, 3),
+                                       "hang_kind": hung_kind})
+                    for i, p in enumerate(procs):
+                        if codes[i] is None:
+                            try:
+                                p.send_signal(signal.SIGKILL)
+                            except OSError:
+                                pass  # died between poll and kill
+                    for i, p in enumerate(procs):
+                        if codes[i] is None:
+                            codes[i] = p.wait()
+                    break
             time.sleep(max(monitor_interval, 0.02))
     except BaseException:
         # KeyboardInterrupt or a spawn-phase failure: nothing supervises
@@ -340,8 +482,11 @@ def _crash_looping(records: List[dict]) -> bool:
 
 def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
                      t_spawn: float, t_exit: float, prev_t_exit: float,
-                     prev_max_step: Optional[int]) -> Tuple[dict,
-                                                            Optional[str]]:
+                     prev_max_step: Optional[int],
+                     ring_status: Optional[dict] = None,
+                     nprocs: Optional[int] = None,
+                     devices_per_proc: Optional[int] = None
+                     ) -> Tuple[dict, Optional[str]]:
     """Build the structured per-attempt record and locate the run dir.
 
     The run dir is learned through a handshake file the workers write
@@ -413,6 +558,18 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
         "steady_recompile_count": steady_recompiles,
         "goodput": beacon_goodput,
     }
+    if nprocs is not None:
+        # The attempt's actual topology (elastic runs shrink/grow between
+        # attempts): what aggregate/debug tooling needs to attribute a
+        # resume to the capacity it ran at.
+        record["nprocs"] = nprocs
+        record["devices_per_proc"] = devices_per_proc
+    if ring_status and ring_status.get("hung"):
+        # Watchdog kill: the frozen window is measured, bounded waste —
+        # its own goodput category (hang), not anonymous lost time.
+        record["hung"] = True
+        record["hang_s"] = ring_status.get("hang_s", 0.0)
+        record["hang_kind"] = ring_status.get("hang_kind", "stall")
     return record, run_dir
 
 
@@ -424,7 +581,9 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             cache_dir: Optional[str] = None,
                             restart_window_s: float = 3600.0,
                             restart_backoff_s: float = 1.0,
-                            restart_backoff_max_s: float = 30.0) -> int:
+                            restart_backoff_max_s: float = 30.0,
+                            hang_timeout_s: float = 0.0,
+                            hang_startup_timeout_s: float = 0.0) -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
@@ -439,11 +598,23 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     * charges a RESTART-RATE BUDGET (``max_restarts`` per sliding
       ``restart_window_s`` window — not a lifetime counter),
     * FAILS FAST on a crash loop (two consecutive attempts with zero step
-      progress stop the run: restarts are not fixing anything), and
+      progress stop the run: restarts are not fixing anything),
+    * RE-DERIVES CAPACITY (elastic topology, ISSUE 10): each attempt's
+      worker count / fake-device count comes from the surviving capacity
+      — on this box simulated by the ``DPT_FORCE_NPROCS`` /
+      ``DPT_FORCE_DEVICES_PER_PROC`` per-attempt schedules ("2,1" =
+      attempt 0 at 2, later attempts at 1) — so a run killed at dp=N
+      resumes at dp=M and the elastic checkpoint/data machinery reshapes
+      it (run/train.py re-derives mesh dims and fast-forwards the data
+      stream by global samples consumed), and
     * appends a structured record to ``attempts.jsonl`` in the run dir
       (attempt, rc, duration, step progress, downtime, resume overhead,
-      post-mortem goodput snapshot) so every second of the run stays
-      attributable (chaos.goodput.aggregate_run).
+      topology, hang window, post-mortem goodput snapshot) so every
+      second of the run stays attributable (chaos.goodput.aggregate_run).
+
+    ``hang_timeout_s`` arms the per-attempt HANG WATCHDOG (see
+    :func:`_run_worker_ring`): silently wedged attempts are killed and
+    restarted instead of burning the budgeted wall time forever.
 
     Reference equivalent: in-process ``torch.distributed.run.run``
     (dist_run.py:13-54). Returns the final attempt's max worker exit code.
@@ -470,6 +641,13 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     if cache_dir is None:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
     budget = _RestartBudget(max_restarts, restart_window_s)
+    # Elastic capacity schedules (shrink/grow simulation): per-attempt
+    # worker/device counts override the flags; parsed ONCE so a malformed
+    # override fails the launch, not attempt 3.
+    nprocs_sched = parse_capacity_schedule(
+        os.environ.get(FORCE_NPROCS_ENV, ""))
+    devices_sched = parse_capacity_schedule(
+        os.environ.get(FORCE_DEVICES_ENV, ""))
     fd, run_dir_file = tempfile.mkstemp(prefix="dpt_run_dir_")
     os.close(fd)
     records: List[dict] = []
@@ -480,17 +658,30 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     try:
         while True:
             t_spawn = time.time()
+            nprocs_a = _capacity_at(nprocs_sched, attempt, nprocs)
+            devices_a = _capacity_at(devices_sched, attempt,
+                                     devices_per_proc)
+            if nprocs_a != nprocs or devices_a != devices_per_proc:
+                print(f"[launcher] attempt {attempt}: capacity override "
+                      f"-> {nprocs_a} worker(s) x {devices_a} device(s) "
+                      f"(was {nprocs} x {devices_per_proc})")
+            ring_status: dict = {}
             code = _run_worker_ring(
-                cmd_base, nprocs, devices_per_proc, monitor_interval,
+                cmd_base, nprocs_a, devices_a, monitor_interval,
                 run_timestamp, log_dir=log_dir, log_tee=log_tee,
                 cache_dir=cache_dir, attempt=attempt,
                 extra_env={"DPT_ATTEMPT": str(attempt),
                            "DPT_SPAWN_T": repr(t_spawn),
-                           "DPT_RUN_DIR_FILE": run_dir_file})
+                           "DPT_RUN_DIR_FILE": run_dir_file},
+                hang_timeout_s=hang_timeout_s,
+                hang_startup_timeout_s=hang_startup_timeout_s,
+                run_dir_file=run_dir_file,
+                status=ring_status)
             t_exit = time.time()
             record, run_dir = _harvest_attempt(
                 run_dir_file, attempt, code, t_spawn, t_exit, prev_t_exit,
-                prev_max_step)
+                prev_max_step, ring_status=ring_status,
+                nprocs=nprocs_a, devices_per_proc=devices_a)
             records.append(record)
             if run_dir and os.path.isdir(run_dir):
                 try:
@@ -576,7 +767,9 @@ def parse_and_autorun(
             log_tee=dist_ns.log_tee,
             restart_window_s=dist_ns.restart_window_s,
             restart_backoff_s=dist_ns.restart_backoff_s,
-            restart_backoff_max_s=dist_ns.restart_backoff_max_s)
+            restart_backoff_max_s=dist_ns.restart_backoff_max_s,
+            hang_timeout_s=dist_ns.hang_timeout_s,
+            hang_startup_timeout_s=dist_ns.hang_startup_timeout_s)
         sys.exit(code)
 
     if dist_ns.distributed:

@@ -30,6 +30,16 @@ Three invariants the tests pin (tests/test_partition.py):
 * axes whose size a dim does not divide fall back to replication at
   materialization time (:func:`fix_spec` — tiny test models shard cleanly
   on any mesh, same contract as the old hand-wired path).
+
+ELASTIC note (ISSUE 10): :func:`zero1_shardings` is a pure function of
+the CURRENT mesh — on a shrink/grow resume the new run's dp may differ
+from the one the checkpoint was written at (and the chosen shard dim may
+even move when divisibility changes), which is fine by construction: the
+trainer hands ``restore_resume_state`` abstract targets built from the
+NEW layout and orbax reshards the stored state into it, in either
+direction of a ``--shard_optimizer`` flip. dp == 1 degenerates to the
+param layout, so shrinking all the way to one replica is just the
+trivial case of the same path.
 """
 
 from __future__ import annotations

@@ -38,6 +38,72 @@ def resolve_run_dir(args: TrainSettings) -> str:
         f"Run_{args.dataset}_lr{args.lr}_seed{args.seed}_{ts}")
 
 
+def build_mesh(args, *, elastic: bool):
+    """Mesh from the configured axis sizes — with ELASTIC re-derivation
+    (ISSUE 10): under the launcher, a restart may land on shrunk/grown
+    capacity (spot preemption took hosts; the simulated
+    DPT_FORCE_DEVICES_PER_PROC schedule changed the ring), and pinned
+    axis sizes that no longer multiply to the surviving device count
+    would fail every restart attempt forever. Re-derive instead: first
+    retry with ``dp=-1`` (data parallelism absorbs the capacity change —
+    its gradient psum is the only collective that tolerates any width),
+    then, if a pinned non-data axis still cannot fit, fall back to
+    pure-DP and warn loudly. Standalone runs (``elastic=False``) keep
+    the hard error: a typo'd --dp should fail, not silently reshape."""
+    from ..parallel import make_mesh
+    from ..utils import logger
+
+    try:
+        return make_mesh(dp=args.dp, fsdp=args.fsdp, sequence=args.sequence,
+                         tensor=args.tensor, expert=args.expert,
+                         pipe=args.pipe)
+    except ValueError as e:
+        if not elastic:
+            raise
+        logger.warn(f"mesh axes do not fit surviving capacity ({e}); "
+                    f"re-deriving data axis for elastic resume")
+        try:
+            return make_mesh(dp=-1, fsdp=args.fsdp, sequence=args.sequence,
+                             tensor=args.tensor, expert=args.expert,
+                             pipe=args.pipe)
+        except ValueError as e2:
+            logger.warn(f"non-data axes do not fit either ({e2}); "
+                        f"falling back to pure data parallelism")
+            return make_mesh(dp=-1)
+
+
+def resume_sample_position(resume_step: int, meta, batch_size: int,
+                           process_count: int):
+    """(skip_batches, consumed_samples) for the train-stream fast-forward.
+
+    The topology-invariant resume position is GLOBAL SAMPLES CONSUMED,
+    not steps: the checkpoint's meta sidecar records the global batch
+    (and cumulative sample count) at save time, so a resume on a
+    different host/device count skips the right number of the NEW
+    stream's batches (see data.skip_batches_for_samples). On an
+    UNCHANGED topology the skip is ``resume_step`` by definition — one
+    step ate one batch of this exact stream — so that path is taken
+    literally, never re-derived from the samples gauge (a subclass whose
+    ``get_batch_length`` counts something other than examples would
+    otherwise desync the bit-identical same-shape resume). Pre-elastic
+    checkpoints (no ``global_batch`` in meta — or no meta at all) are
+    treated as same-topology, preserving the old behavior exactly."""
+    from ..data import skip_batches_for_samples
+
+    gb_now = batch_size * max(process_count, 1)
+    saved_gb = (int(meta["global_batch"])
+                if meta and meta.get("global_batch") else gb_now)
+    # the samples gauge continues from the recorded count when present
+    # (exact even for exotic get_batch_length overrides)
+    consumed = (int(meta["samples"])
+                if meta and meta.get("samples") is not None
+                else resume_step * saved_gb)
+    if saved_gb == gb_now:
+        return resume_step, consumed
+    return skip_batches_for_samples(resume_step * saved_gb, batch_size,
+                                    process_count), consumed
+
+
 def main(namespace: argparse.Namespace) -> None:
     """(reference run/train.py:10-121; late imports keep ``--help`` fast,
     mirroring the reference's in-function imports at train.py:15-24)"""
@@ -48,7 +114,7 @@ def main(namespace: argparse.Namespace) -> None:
     from .. import parallel
     from ..data import load_data_from_args
     from ..models import create_model_from_config, seed_all
-    from ..parallel import dist, make_mesh
+    from ..parallel import dist
     from ..parallel.mesh import local_mesh_info
     from ..utils import logger
     from ..utils.trainer import TrainLoop
@@ -113,8 +179,16 @@ def main(namespace: argparse.Namespace) -> None:
                          "stages); without it the pipe axis would only "
                          "replicate work")
     workload = create_model_from_config(**args.dict())
-    mesh = make_mesh(dp=args.dp, fsdp=args.fsdp, sequence=args.sequence,
-                     tensor=args.tensor, expert=args.expert, pipe=args.pipe)
+    # Elastic mesh derivation: re-derive axis sizes only when capacity
+    # can actually have CHANGED under this worker — a restart attempt
+    # (> 0) or an active capacity-override schedule (which can shrink
+    # attempt 0 too). Attempt 0 of an ordinary supervised run keeps the
+    # hard error: there a non-fitting --dp is a typo, not a preemption.
+    from ..parallel.launcher import FORCE_DEVICES_ENV, FORCE_NPROCS_ENV
+    elastic = (int(os.environ.get("DPT_ATTEMPT") or -1) > 0
+               or bool(os.environ.get(FORCE_NPROCS_ENV))
+               or bool(os.environ.get(FORCE_DEVICES_ENV)))
+    mesh = build_mesh(args, elastic=elastic)
     logger.info(local_mesh_info(mesh))
 
     if rank == 0:  # args snapshot for reproducibility (train.py:82-87)
@@ -208,9 +282,13 @@ def main(namespace: argparse.Namespace) -> None:
 
     # Exact-resume data order: fast-forward both streams so the continued
     # run consumes the batches the uninterrupted one would have — together
-    # with the step-derived train RNG this makes a resumed run
-    # bit-identical. One train step eats one train batch; eval eats one
-    # batch per eval_interval steps.
+    # with the step-derived train RNG this makes a same-topology resume
+    # bit-identical. The train-stream position is GLOBAL SAMPLES CONSUMED
+    # (recorded in the meta sidecar), not steps: an ELASTIC resume on a
+    # different host count has a different global batch, and skipping
+    # "resume_step batches" of the new stream would desync the sample
+    # sequence (ISSUE 10 — the loss-continuity contract of shrink/grow).
+    # Eval eats one batch per eval_interval steps.
     resume_step = loop.step
     # meta travels WITH the checkpoint: read it from the directory the
     # restored model_ lives in (an explicit --resume_checkpoint may point
@@ -219,6 +297,15 @@ def main(namespace: argparse.Namespace) -> None:
     meta = (load_meta(os.path.dirname(loop.resumed_from.rstrip("/")),
                       resume_step)
             if resume_step and loop.resumed_from else None)
+    train_skip, consumed = resume_sample_position(
+        resume_step, meta, args.batch_size, jax.process_count())
+    if train_skip != resume_step and rank == 0:
+        logger.info(
+            f"elastic resume: checkpoint was written at global batch "
+            f"{meta.get('global_batch')} ({consumed} samples consumed); "
+            f"fast-forwarding {train_skip} batches of the current "
+            f"global-batch-{loop.global_batch} stream (loss-continuity, "
+            f"not bit-identity, across the topology change)")
     if meta is not None and "eval_batches_consumed" in meta:
         # the checkpoint records exactly how many eval batches were drawn
         # — the fast-forward no longer assumes --eval_interval is
@@ -234,16 +321,19 @@ def main(namespace: argparse.Namespace) -> None:
                 f"({args.eval_interval}) is unchanged from the original "
                 f"run (train stream is exact either way)")
     if resume_step and rank == 0:
-        logger.info(f"fast-forwarding data stream past {resume_step} "
+        logger.info(f"fast-forwarding data stream past {train_skip} "
                     f"consumed train batches / {eval_skip} eval batches "
                     f"(exact-order resume)")
     loop.set_data(
-        load_data_from_args("train", skip_batches=resume_step,
+        load_data_from_args("train", skip_batches=train_skip,
                             **args.dict()),
         eval_data=load_data_from_args(
             "valid", skip_batches=eval_skip,
             **{**args.dict(), "deterministic": True}),
-        eval_batches_consumed=eval_skip)
+        eval_batches_consumed=eval_skip,
+        # the samples gauge continues from the TRUE consumed count, not
+        # step x (possibly different) new global batch
+        samples_consumed=consumed if resume_step else None)
     n_m = loop.n_params / 1e6
     logger.info(f"the parameter count is {loop.n_params} ({n_m:.1f}M)")
     loop.run_loop()

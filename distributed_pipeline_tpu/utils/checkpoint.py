@@ -12,6 +12,17 @@ single-writer semantics, sharded-array save/restore that keeps each chip's
 shard on-chip (no host gather), and atomic finalization. Restore takes an
 abstract target tree so arrays come back with the requested shardings.
 
+ELASTIC-TOPOLOGY contract (ISSUE 10): the abstract target carries the
+shardings of the mesh the RESUMING run built — which need not be the
+mesh the checkpoint was written on. Orbax reshards on restore, so a
+checkpoint saved at dp=N restores cleanly at dp=M (params, Adam moments,
+EMA copies — including ZeRO-1 data-axis-sharded state in either
+direction, since :func:`restore_resume_state`'s ``abstract_opt`` /
+``abstract_ema`` always describe the NEW run's layout). The meta sidecar
+(:func:`save_meta`) records the save-time ``global_batch`` / ``samples``
+/ mesh shape so run/train.py can fast-forward the data stream by global
+samples consumed rather than per-host step position.
+
 Paths go through ``etils.epath``, so run dirs and resume paths may be remote
 URIs (``gs://...``) exactly like the reference's blobfile-backed reads
 (``/root/reference/basic_utils/dist_util.py:118-124``, SURVEY.md §5.4).
@@ -156,11 +167,13 @@ def resume_target(directory: str,
 
 def save_meta(directory: str, step: int, meta: dict) -> None:
     """Write the per-checkpoint metadata sidecar (``meta_{step:06d}.json``):
-    run facts the filenames cannot carry — today the consumed-eval-batch
-    count and the eval interval, so a resume can fast-forward the eval
-    stream exactly even when ``--eval_interval`` changed (the r4 advisor's
-    'a warning is not a contract'). Process 0 only; tiny synchronous
-    write."""
+    run facts the filenames cannot carry — the consumed-eval-batch count
+    and eval interval (so a resume fast-forwards the eval stream exactly
+    even when ``--eval_interval`` changed; the r4 advisor's 'a warning is
+    not a contract') and, for elastic resume, the save-time
+    ``global_batch`` / cumulative ``samples`` / mesh shape (the
+    topology-invariant data-stream position). Process 0 only; tiny
+    synchronous write."""
     import json as _json
 
     if jax.process_index() != 0:

@@ -354,6 +354,13 @@ class GoodputTracker:
       passed (work between the last checkpoint and a crash is lost and
       paid again after resume).
 
+    One category deliberately does NOT live here: ``hang_s`` — the window
+    a silently wedged attempt burned before the launcher's watchdog
+    killed it. A hung process cannot attribute its own waste, so the
+    LAUNCHER measures it (beacon freeze -> kill) and books it into the
+    attempt record; :func:`chaos.goodput.aggregate_run` folds it as its
+    own run-level category next to these.
+
     ``useful_step_s`` is the RESIDUAL: wall − Σ overheads. That makes the
     decomposition account for every second by construction — the honest
     framing, since "useful" legitimately includes dispatch and host-loop

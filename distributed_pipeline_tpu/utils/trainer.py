@@ -325,13 +325,20 @@ class TrainLoop:
             length_of=self.get_batch_length, stats=self.stalls)
 
     def set_data(self, data: Iterator, *, eval_data: Optional[Iterator] = None,
-                 eval_batches_consumed: Optional[int] = None) -> None:
+                 eval_batches_consumed: Optional[int] = None,
+                 samples_consumed: Optional[int] = None) -> None:
         """Late data wiring: iterators created AFTER construction, so their
         resume fast-forward can use the step this loop ACTUALLY restored —
         which may be older than the newest checkpoint when the restore
         walked back past a corrupt one (run/train.py builds the loop
         first, reads ``loop.step``, then skips exactly that many batches).
-        Applies the same prefetch wrapping the constructor would."""
+        Applies the same prefetch wrapping the constructor would.
+
+        ``samples_consumed`` re-seeds the cumulative ``samples`` gauge:
+        on an ELASTIC resume (global batch changed with the topology) the
+        constructor's ``step * global_batch`` estimate uses the NEW
+        global batch and would mis-state history — the checkpoint's meta
+        sidecar records the true count."""
         self.data = (self._wrap_prefetch(data)
                      if self.prefetch_depth > 0 and data is not None
                      else data)
@@ -339,6 +346,8 @@ class TrainLoop:
             self.eval_data = eval_data
         if eval_batches_consumed is not None:
             self.eval_batches_consumed = eval_batches_consumed
+        if samples_consumed is not None:
+            self._samples = int(samples_consumed)
 
     def _stall_sum(self) -> float:
         s = self.stalls.sums()
@@ -1046,6 +1055,15 @@ class TrainLoop:
         ckpt_lib.save_meta(self.checkpoint_dir, self.step, {
             "eval_batches_consumed": self.eval_batches_consumed,
             "eval_interval": self.eval_interval,
+            # Elastic-resume topology facts (ISSUE 10): the GLOBAL batch
+            # and cumulative sample count at save time. A resume on a
+            # DIFFERENT topology (more/fewer hosts) must fast-forward the
+            # data stream by global samples consumed — step count alone is
+            # meaningless across a global-batch change. mesh shape rides
+            # along for debugging/attribution.
+            "global_batch": self.global_batch,
+            "samples": self._samples,
+            "mesh": {a: int(s) for a, s in self.mesh.shape.items()},
         })
         mode = ("saved checkpoint" if wait
                 else "scheduled async checkpoint save")

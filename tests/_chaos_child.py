@@ -4,12 +4,27 @@ TrainLoop's part: it stamps the run dir into DPT_RUN_DIR_FILE, advances a
 progress beacon, and exits with a scripted code per attempt).
 
 Argv: --dir RUNDIR --fail_times N [--steps_per_attempt K] [--no_beacon]
+      [--hang_s S [--hang_attempts N]] [--step_interval_s S]
+      [--no_first_beacon_hang]
 
 Attempt index arrives via DPT_ATTEMPT (set by the launcher). Exits 1 while
 attempt < fail_times, else 0. With --steps_per_attempt 0 the beacon still
 reports the previous max (zero progress — the crash-loop case); with
 --no_beacon it writes none at all (a non-TrainLoop script — progress
-unknown)."""
+unknown).
+
+Hang-watchdog modes (ISSUE 10):
+
+* ``--hang_s S``: attempts below ``--hang_attempts`` write ONE beacon and
+  then wedge alive for S seconds (a stuck collective). The watchdog must
+  SIGKILL the ring; a later, non-hanging attempt completes the run.
+* ``--no_first_beacon_hang``: with ``--hang_s``, the hanging attempt
+  writes NO beacon first — the init-wedge case ``--hang_startup_timeout_s``
+  exists for.
+* ``--step_interval_s S``: a STRAGGLER — the beacon advances one step
+  every S seconds for ``--steps_per_attempt`` steps. Slow but alive: the
+  hang watchdog must ride through it.
+"""
 
 import argparse
 import json
@@ -21,6 +36,10 @@ parser.add_argument("--dir", required=True)
 parser.add_argument("--fail_times", type=int, default=0)
 parser.add_argument("--steps_per_attempt", type=int, default=5)
 parser.add_argument("--no_beacon", action="store_true")
+parser.add_argument("--hang_s", type=float, default=0.0)
+parser.add_argument("--hang_attempts", type=int, default=1)
+parser.add_argument("--no_first_beacon_hang", action="store_true")
+parser.add_argument("--step_interval_s", type=float, default=0.0)
 ns = parser.parse_args()
 
 attempt = int(os.environ.get("DPT_ATTEMPT") or 0)
@@ -31,20 +50,51 @@ if run_dir_file:
     with open(run_dir_file, "w") as f:
         f.write(os.path.abspath(ns.dir))
 
-if not ns.no_beacon:
-    spawn_t = float(os.environ.get("DPT_SPAWN_T") or time.time())
-    step = (attempt + 1) * ns.steps_per_attempt
+spawn_t = float(os.environ.get("DPT_SPAWN_T") or time.time())
+
+
+def write_beacon(step: int) -> None:
+    # The snapshot keeps the accounting identity (wall == useful + sum of
+    # categories) AND slightly UNDERSTATES wall vs the attempt's real
+    # duration: aggregate_run books the shortfall as lost, so stub folds
+    # land near accounted_frac 1.0 like a real TrainLoop's tracker
+    # (overstating would double count — the lost residual clamps at 0).
+    startup = max(0.0, time.time() - spawn_t)
     payload = {
         "step": step, "t": time.time(), "attempt": attempt, "rank": 0,
+        "start_step": (attempt) * ns.steps_per_attempt,
         "recompile_count": 0, "steady_recompile_count": 0,
-        "goodput": {"wall_s": time.time() - spawn_t + 0.5,
-                    "useful_step_s": 0.4, "goodput": 0.8,
-                    "startup_s": max(0.0, time.time() - spawn_t),
-                    "setup_s": 0.05, "restore_s": 0.02, "compile_s": 0.03,
+        "goodput": {"wall_s": startup + 0.04,
+                    "useful_step_s": 0.02, "goodput": 0.1,
+                    "startup_s": startup,
+                    "setup_s": 0.01, "restore_s": 0.005,
+                    "compile_s": 0.005,
                     "save_s": 0.0, "data_stall_s": 0.0, "recompute_s": 0.0},
     }
-    with open(os.path.join(ns.dir, ".progress_rank0.json"), "w") as f:
+    tmp = os.path.join(ns.dir, ".progress_rank0.json.tmp")
+    with open(tmp, "w") as f:
         f.write(json.dumps(payload))
+    os.replace(tmp, os.path.join(ns.dir, ".progress_rank0.json"))
+
 
 print(f"CHAOSCHILD attempt={attempt}", flush=True)
+
+if ns.hang_s > 0 and attempt < ns.hang_attempts:
+    # The wedge: alive, silent, never advancing — only the launcher's
+    # hang watchdog can end this attempt (SIGKILL interrupts the sleep).
+    if not ns.no_first_beacon_hang and not ns.no_beacon:
+        write_beacon((attempt + 1) * ns.steps_per_attempt)
+    time.sleep(ns.hang_s)
+    raise SystemExit(1)  # only reached when NO watchdog was armed
+
+if ns.step_interval_s > 0 and not ns.no_beacon:
+    # The straggler: progress continues, just slowly — beacon mtime
+    # advances every step, so a correct watchdog never fires.
+    base = attempt * ns.steps_per_attempt
+    for k in range(ns.steps_per_attempt):
+        write_beacon(base + k + 1)
+        time.sleep(ns.step_interval_s)
+elif not ns.no_beacon:
+    write_beacon((attempt + 1) * ns.steps_per_attempt)
+
 raise SystemExit(1 if attempt < ns.fail_times else 0)

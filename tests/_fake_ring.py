@@ -1,0 +1,48 @@
+"""Shared launcher-ring test fake (ISSUE 10 satellite).
+
+Every test that exercises ``run_argv_as_distributed``'s supervision logic
+without spawning real workers used to hand-roll a ``_run_worker_ring``
+monkeypatch stub with the ring's POSITIONAL signature spelled out — so
+every new launcher kwarg broke several test files at once (CHANGES r10).
+This factory owns the stub once, with a ``**kw``-tolerant signature: new
+launcher kwargs land in each recorded call dict instead of in a
+TypeError.
+
+Usage::
+
+    fake = make_fake_ring(codes=(1, 0))        # attempt 0 fails, 1 succeeds
+    monkeypatch.setattr(launcher, "_run_worker_ring", fake)
+    launcher.run_argv_as_distributed("mod", [], nprocs=2, max_restarts=3)
+    fake.calls[0]["nprocs"]                    # every arg, by name
+    fake.calls[1]["run_timestamp"]
+
+``codes`` is indexed by call count and clamps to its last entry (so
+``codes=(1,)`` fails forever). ``side_effect(call)`` runs per attempt
+with the recorded call dict — e.g. to mutate ``call["status"]`` the way
+a hang-killed real ring would, or to write beacons into the run dir.
+"""
+
+from typing import Callable, Optional, Sequence
+
+
+def make_fake_ring(codes: Sequence[int] = (0,),
+                   side_effect: Optional[Callable[[dict], object]] = None):
+    """Build a ``_run_worker_ring`` stand-in; see module docstring."""
+
+    calls = []
+
+    def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
+                  run_timestamp=None, **kw):
+        call = dict(cmd_base=list(cmd_base), nprocs=nprocs,
+                    devices_per_proc=devices_per_proc,
+                    monitor_interval=monitor_interval,
+                    run_timestamp=run_timestamp, **kw)
+        calls.append(call)
+        if side_effect is not None:
+            rc = side_effect(call)
+            if rc is not None:
+                return rc
+        return codes[min(len(calls) - 1, len(codes) - 1)]
+
+    fake_ring.calls = calls
+    return fake_ring

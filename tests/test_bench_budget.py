@@ -87,9 +87,9 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert skipped, "1s budget must skip every non-headline leg"
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
-    # (headline + prefetch A/B twin + zero1 A/B + chaos + noaccum + moe8
-    # + moe8-cf1 + scan)
-    assert len(final["configs"]) == 8
+    # (headline + prefetch A/B twin + zero1 A/B + chaos + elastic +
+    # noaccum + moe8 + moe8-cf1 + scan)
+    assert len(final["configs"]) == 9
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
@@ -229,18 +229,13 @@ def test_cache_dir_reaches_worker_env(tmp_path):
 def test_launcher_forwards_cache_env_to_ring(monkeypatch, tmp_path):
     from distributed_pipeline_tpu.parallel import launcher
 
-    seen = {}
+    from tests._fake_ring import make_fake_ring
 
-    def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
-                  run_timestamp=None, log_dir="", log_tee=False,
-                  cache_dir="", **kw):
-        seen["cache_dir"] = cache_dir
-        return 0
-
-    monkeypatch.setattr(launcher, "_run_worker_ring", fake_ring)
+    fake = make_fake_ring()
+    monkeypatch.setattr(launcher, "_run_worker_ring", fake)
     monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
     assert launcher.run_argv_as_distributed("mod", [], nprocs=2) == 0
-    assert seen["cache_dir"] == str(tmp_path)
+    assert fake.calls[0]["cache_dir"] == str(tmp_path)
 
 
 # ------------------------------------------------- AOT compile-time metrics

@@ -505,6 +505,7 @@ def _train_argv(steps, extra=()):
 
 
 @pytest.mark.slow  # spawns 3 worker processes + an uninterrupted twin ring
+@pytest.mark.chaos
 def test_chaos_ring_end_to_end_bit_continuous(tmp_path):
     """The tentpole acceptance: a supervised CPU ring with an injected
     SIGKILL at step 4 AND a corrupted newest checkpoint must (a) restart

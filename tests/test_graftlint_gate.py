@@ -32,6 +32,9 @@ GATED_PATHS = [
     # the partition/ZeRO-1 tests drive TrainLoop outer loops AND handle
     # shardings directly — both GL007 and GL008 territory
     os.path.join(ROOT, "tests", "test_partition.py"),
+    # the elastic/watchdog tests drive TrainLoop outer loops across
+    # topology changes (GL007) and assert on restored sharded state
+    os.path.join(ROOT, "tests", "test_elastic.py"),
 ]
 
 

@@ -260,20 +260,16 @@ def test_launcher_pins_timestamp_across_attempts(monkeypatch):
 
     from distributed_pipeline_tpu.parallel import launcher
 
+    from tests._fake_ring import make_fake_ring
+
     monkeypatch.delenv("DPT_RUN_TIMESTAMP", raising=False)
-    seen = []
-
-    def fake_ring(cmd_base, nprocs, devices_per_proc, monitor_interval,
-                  run_timestamp=None, log_dir="", log_tee=False,
-                  cache_dir="", **kw):
-        seen.append(run_timestamp)
-        return 1 if len(seen) < 2 else 0  # fail once, then succeed
-
-    monkeypatch.setattr(launcher, "_run_worker_ring", fake_ring)
+    fake = make_fake_ring(codes=(1, 0))  # fail once, then succeed
+    monkeypatch.setattr(launcher, "_run_worker_ring", fake)
     code = launcher.run_argv_as_distributed("mod", [], nprocs=2,
                                             max_restarts=3,
                                             restart_backoff_s=0.01)
     assert code == 0
+    seen = [c["run_timestamp"] for c in fake.calls]
     assert len(seen) == 2 and seen[0] is not None and seen[0] == seen[1]
     assert "DPT_RUN_TIMESTAMP" not in os.environ  # no process-global leak
 
