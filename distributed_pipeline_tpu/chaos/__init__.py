@@ -4,10 +4,12 @@ The recovery machinery — the self-relaunching launcher, checkpoint
 auto-resume, the persistent compile cache — is only as real as the failures
 it has survived. This package supplies the failures (:class:`ChaosPlan` /
 :class:`ChaosInjector`: scheduled kills, crashes mid-checkpoint-save, data
-stalls, step-loop wedges, stragglers, corrupted checkpoints) and the
+stalls, step-loop wedges, stragglers, corrupted checkpoints — and, for the
+serving fleet, replica kills/wedges and corrupted swap checkpoints) and the
 metric that proves survival was
 cheap (:mod:`.goodput`: useful-step time / wall time, with every second of
-a restarted run attributed to a category).
+a restarted run attributed to a category; :func:`~.goodput.aggregate_serving`
+is the serving-side twin).
 
 Import-light by design: the launcher imports this before jax exists in the
 process.
@@ -15,22 +17,36 @@ process.
 
 from .goodput import (
     aggregate_run,
+    aggregate_serving,
     append_attempt,
     attempts_path,
     beacon_max_step,
     beacon_path,
     goodput_record_path,
+    list_replica_dirs,
     read_attempts,
     read_beacons,
     read_goodput_records,
+    read_journal,
+    read_serving_records,
+    replica_dir,
+    serving_journal_path,
+    serving_record_path,
 )
-from .inject import ChaosInjector, corrupt_newest_checkpoint
+from .inject import (
+    ChaosInjector,
+    corrupt_checkpoint_payload,
+    corrupt_newest_checkpoint,
+)
 from .plan import CHAOS_PLAN_ENV, ChaosFault, ChaosPlan
 
 __all__ = [
     "ChaosFault", "ChaosPlan", "ChaosInjector", "CHAOS_PLAN_ENV",
-    "corrupt_newest_checkpoint",
+    "corrupt_newest_checkpoint", "corrupt_checkpoint_payload",
     "aggregate_run", "append_attempt", "attempts_path", "beacon_max_step",
     "beacon_path", "goodput_record_path", "read_attempts", "read_beacons",
     "read_goodput_records",
+    "aggregate_serving", "list_replica_dirs", "read_journal",
+    "read_serving_records", "replica_dir", "serving_journal_path",
+    "serving_record_path",
 ]

@@ -26,8 +26,26 @@ watchdog killing it — time a silently wedged worker burned while still
 "alive". Without the watchdog that window is unbounded; with it, it is
 measured and bounded by ``--hang_timeout_s``.
 
+The SERVING half (ISSUE 11) mirrors the same discipline for a replica
+fleet. A fleet dir holds one ``replica_{i}`` run dir per replica (each
+supervised by its own launcher ring, so ``attempts.jsonl`` + beacons come
+for free) plus the router's durable request ``journal.jsonl``; replica
+workers write ``serving_attempt{A:03d}.json`` sidecars (clean exit) and a
+``serving`` snapshot inside their beacons (the kill flight recorder).
+:func:`aggregate_serving` folds the whole fleet into::
+
+    serving wall == serving + drain + replay + swap + downtime + lost
+
+with ``accounted_frac == 1.0`` by construction — ``replay`` is the
+serving-shaped time whose output was thrown away (work a killed replica
+did on requests that later re-ran on a sibling, measured by the router
+into the journal), ``drain``/``swap`` are the hot-swap windows, and
+``lost`` is attempt wall covered by no snapshot.
+
 Import-light (no jax): the launcher reads and writes these artifacts
-before/after worker processes exist.
+before/after worker processes exist. The fleet-dir layout constants live
+HERE (not in serving/) for the same reason the beacon naming does: the
+launcher-adjacent readers must not pay a jax import to find a file.
 """
 
 from __future__ import annotations
@@ -42,6 +60,9 @@ __all__ = [
     "beacon_path", "read_beacons", "beacon_max_step", "beacon_mtimes",
     "attempts_path", "append_attempt", "read_attempts",
     "goodput_record_path", "read_goodput_records", "aggregate_run",
+    "replica_dir", "list_replica_dirs", "serving_journal_path",
+    "read_journal", "serving_record_path", "read_serving_records",
+    "aggregate_serving",
 ]
 
 _BEACON_RE = re.compile(r"\.progress_rank(\d+)\.json$")
@@ -135,6 +156,67 @@ def read_goodput_records(run_dir: str) -> Dict[int, dict]:
     return out
 
 
+# ------------------------------------------------------- serving artifacts
+
+_REPLICA_RE = re.compile(r"replica_(\d+)$")
+_SERVING_RECORD_RE = re.compile(r"serving_attempt(\d+)\.json$")
+
+
+def replica_dir(fleet_dir: str, rid: int) -> str:
+    """One replica's run dir inside a fleet dir — the dir its supervising
+    launcher ring writes ``attempts.jsonl``/beacons into and its worker
+    writes serving sidecars into. Owned here so the fleet writer
+    (serving/fleet.py) and the import-light readers agree on the layout
+    without serving/ imports."""
+    return os.path.join(fleet_dir, f"replica_{rid}")
+
+
+def list_replica_dirs(fleet_dir: str) -> List[str]:
+    out = []
+    for path in glob.glob(os.path.join(fleet_dir, "replica_*")):
+        if _REPLICA_RE.search(path) and os.path.isdir(path):
+            out.append(path)
+    return sorted(out, key=lambda p: int(_REPLICA_RE.search(p).group(1)))
+
+
+def serving_journal_path(fleet_dir: str) -> str:
+    """The router's durable request journal (append-only JSONL)."""
+    return os.path.join(fleet_dir, "journal.jsonl")
+
+
+def read_journal(path: str) -> List[dict]:
+    """Journal events, torn-tail tolerant (same contract as
+    :func:`read_attempts` — a killed router's last line may be partial)."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out
+
+
+def serving_record_path(run_dir: str, attempt: int) -> str:
+    return os.path.join(run_dir, f"serving_attempt{attempt:03d}.json")
+
+
+def read_serving_records(run_dir: str) -> Dict[int, dict]:
+    """Clean-exit serving sidecars per attempt (the serving counterpart of
+    :func:`read_goodput_records`; a distinct filename prefix so training
+    consumers never misparse one)."""
+    out: Dict[int, dict] = {}
+    for path in glob.glob(os.path.join(run_dir, "serving_attempt*.json")):
+        payload = _read_json(path)
+        if _SERVING_RECORD_RE.search(path) and isinstance(payload, dict):
+            out[int(payload.get("attempt", 0))] = payload
+    return out
+
+
 def _fnum(x: Any, default: float = 0.0) -> float:
     """Defensive number coercion for fields read off disk: a killed
     attempt's artifacts may carry ``null`` (a beacon snapshotted mid-
@@ -164,11 +246,19 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
     Degrades, never raises: a hard-killed attempt with a missing or
     zero-byte sidecar/beacon, or one whose snapshot carries nulls, folds
     as ``lost`` time — ``accounted_frac`` stays 1.0 by construction.
+    SERVING attempts in a mixed run dir (a replica dir fed to the
+    training fold, or a dir where both halves ran) degrade the same way:
+    their artifacts carry a ``serving`` snapshot / ``serving_attempt*``
+    sidecar and NO training goodput, so their wall folds to ``lost`` and
+    they are counted in ``serving_attempts`` — use
+    :func:`aggregate_serving` for the serving-side decomposition.
     """
     attempts = read_attempts(run_dir)
     sidecars = read_goodput_records(run_dir)
+    serving_recs = read_serving_records(run_dir)
     cats = {c: 0.0 for c in _CATEGORIES}
     useful = lost = downtime = hang = 0.0
+    serving_attempts = 0
     per_attempt: List[dict] = []
 
     def _fold(idx: int, duration_s: Optional[float], gp: Optional[dict],
@@ -191,6 +281,13 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
         for rec in attempts:
             idx = int(_fnum(rec.get("attempt")))
             gp = sidecars.get(idx) or rec.get("goodput") or None
+            # A serving attempt (replica worker under the same launcher)
+            # has serving artifacts and no training goodput: its wall
+            # degrades to lost here instead of raising or misparsing.
+            is_serving = (idx in serving_recs
+                          or isinstance(rec.get("serving"), dict))
+            if is_serving and not isinstance(gp, dict):
+                serving_attempts += 1
             downtime += _fnum(rec.get("downtime_s"))
             dur = rec.get("duration_s")
             if isinstance(dur, bool) or not isinstance(dur, (int, float)):
@@ -204,7 +301,8 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
                                 "goodput_source": ("sidecar" if idx in sidecars
                                                    else "beacon"
                                                    if isinstance(gp, dict)
-                                                   else None)})
+                                                   else "serving"
+                                                   if is_serving else None)})
         wall = (_fnum(attempts[-1].get("t_exit"))
                 - _fnum(attempts[0].get("t_spawn")))
     else:
@@ -226,5 +324,95 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
         "accounted_s": accounted,
         "accounted_frac": accounted / wall,
         "attempts": len(per_attempt),
+        "serving_attempts": serving_attempts,
         "per_attempt": per_attempt,
+    }
+
+
+def aggregate_serving(fleet_dir: str) -> Dict[str, Any]:
+    """Fold a serving fleet's artifacts into one ledger::
+
+        wall == serving + drain + replay + swap + downtime + lost
+
+    ``wall`` is summed REPLICA wall (each replica's first-spawn ->
+    last-exit span, which the launcher's attempt records decompose into
+    durations + downtime exactly), so an N-replica fleet's wall is ~N x
+    the fleet's clock time — every replica-second is accounted, the same
+    contract as the training fold. Per attempt, the in-attempt snapshot
+    is the clean-exit ``serving_attempt*`` sidecar when one exists, else
+    the launcher's post-mortem ``serving`` beacon snapshot; attempt wall
+    covered by neither folds to ``lost``. ``replay`` — work a dead or
+    wedged replica did on requests that later re-ran on a sibling — is
+    ROUTER-attributed (the journal's ``replay`` events carry the wasted
+    window) and re-booked out of ``serving``, clamped so the identity
+    stays exact — note the windows are PER REQUEST and may overlap the
+    same wall period (N requests in flight on one killed replica each
+    book their own assign->death window), so under heavy replay the
+    clamp can consume all of ``serving``. Degrades, never raises, like
+    :func:`aggregate_run`.
+    """
+    serving = drain = swap = lost = downtime = wall = 0.0
+    per_replica: List[dict] = []
+    n_attempts = 0
+    for rd in list_replica_dirs(fleet_dir):
+        attempts = read_attempts(rd)
+        sidecars = read_serving_records(rd)
+        r = {"replica": int(_REPLICA_RE.search(rd).group(1)),
+             "attempts": len(attempts), "serving_s": 0.0, "lost_s": 0.0}
+        for rec in attempts:
+            n_attempts += 1
+            idx = int(_fnum(rec.get("attempt")))
+            snap = sidecars.get(idx) or rec.get("serving") or None
+            if not isinstance(snap, dict):
+                snap = None
+            downtime += _fnum(rec.get("downtime_s"))
+            wall += _fnum(rec.get("downtime_s"))
+            dur = rec.get("duration_s")
+            if isinstance(dur, bool) or not isinstance(dur, (int, float)):
+                dur = max(0.0, _fnum(rec.get("t_exit"))
+                          - _fnum(rec.get("t_spawn")))
+            dur = float(dur)
+            wall += dur
+            if snap:
+                # the worker's tracker keeps wall == serving + drain +
+                # swap identically (serving is the residual), so folding
+                # the parts preserves the identity; the uncovered tail
+                # (snapshot -> kill) is lost
+                d = _fnum(snap.get("drain_s"))
+                s = _fnum(snap.get("swap_s"))
+                sv = _fnum(snap.get("serving_s"))
+                drain += d
+                swap += s
+                serving += sv
+                r["serving_s"] += sv
+                att_lost = max(0.0, dur - _fnum(snap.get("wall_s")))
+            else:
+                att_lost = dur
+            lost += att_lost
+            r["lost_s"] += att_lost
+        per_replica.append(r)
+    # Router-attributed replay: serving-shaped time whose output was
+    # discarded. Re-booked out of `serving`, clamped to keep the identity
+    # exact even against a torn/overstated journal.
+    replay_raw = sum(
+        _fnum(ev.get("wasted_s"))
+        for ev in read_journal(serving_journal_path(fleet_dir))
+        if ev.get("ev") == "replay")
+    replay = min(max(0.0, replay_raw), serving)
+    serving -= replay
+    wall = max(wall, 1e-9)
+    accounted = serving + drain + replay + swap + downtime + lost
+    return {
+        "wall_s": wall,
+        "serving_s": serving,
+        "drain_s": drain,
+        "replay_s": replay,
+        "swap_s": swap,
+        "downtime_s": downtime,
+        "lost_s": lost,
+        "accounted_s": accounted,
+        "accounted_frac": accounted / wall,
+        "replicas": len(per_replica),
+        "attempts": n_attempts,
+        "per_replica": per_replica,
     }

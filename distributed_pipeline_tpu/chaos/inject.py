@@ -24,7 +24,8 @@ from typing import Optional
 
 from .plan import ChaosFault, ChaosPlan
 
-__all__ = ["ChaosInjector", "corrupt_newest_checkpoint"]
+__all__ = ["ChaosInjector", "corrupt_newest_checkpoint",
+           "corrupt_checkpoint_payload"]
 
 # Payload bytes for checkpoint corruption: long enough to guarantee any
 # parser/checksum downstream sees garbage, loud enough to grep in a hexdump.
@@ -33,7 +34,10 @@ _GARBAGE = b"\xde\xad\xbe\xef CHAOS-CORRUPTED " * 8
 # orbax's commit marker — corruption must leave it intact so the torn
 # checkpoint still LOOKS finalized and exercises the restore walk-back
 # (deleting it would exercise the cheaper discovery-skip path instead).
-_COMMIT_MARKERS = ("_CHECKPOINT_METADATA", "commit_success.txt")
+# Public under COMMIT_MARKERS: the serving fleet's jax-free checkpoint
+# discovery needs the same notion of "finalized".
+_COMMIT_MARKERS = COMMIT_MARKERS = ("_CHECKPOINT_METADATA",
+                                    "commit_success.txt")
 
 
 def corrupt_newest_checkpoint(directory: str) -> Optional[str]:
@@ -62,7 +66,18 @@ def corrupt_newest_checkpoint(directory: str) -> Optional[str]:
             best_step, best = int(digits), path
     if best is None:
         return None
-    for root, _, files in os.walk(best):
+    corrupt_checkpoint_payload(best)
+    return best
+
+
+def corrupt_checkpoint_payload(path: str) -> bool:
+    """Garble the head of every payload file under ONE checkpoint dir,
+    leaving the commit markers intact (the dir still looks finalized; any
+    restore of it must fail). Returns whether anything was written —
+    ``False`` means the dir had no payload to damage (missing/empty), so
+    a caller injecting a swap fault can tell the fault went nowhere."""
+    wrote = False
+    for root, _, files in os.walk(path):
         for fname in files:
             if fname in _COMMIT_MARKERS:
                 continue
@@ -70,9 +85,10 @@ def corrupt_newest_checkpoint(directory: str) -> Optional[str]:
             try:
                 with open(fpath, "r+b") as f:
                     f.write(_GARBAGE)
+                wrote = True
             except OSError:
                 pass  # a file we cannot open is already damage enough
-    return best
+    return wrote
 
 
 class ChaosInjector:
@@ -178,6 +194,56 @@ class ChaosInjector:
             time.sleep(fault.seconds)
             stalled += fault.seconds
         return stalled
+
+    # ------------------------------------------------------- serving hooks
+
+    def on_serve_tick(self, admitted: int, in_flight: int) -> None:
+        """Serving replica hook, called once per scheduler tick with the
+        replica's cumulative ADMITTED request count and its current
+        in-flight count. ``kill_replica`` / ``stall_replica`` faults for
+        this replica (``rank`` = replica id) fire at the first tick where
+        ``admitted >= step`` AND something is in flight — "mid-request"
+        by construction, whatever the traffic process did to the
+        schedule. Threshold (not equality) because admitted counts can
+        jump by a whole prefill batch in one tick. Marker-once like every
+        fault: a respawned replica sails past."""
+        if in_flight <= 0:
+            return
+        due = [(i, f) for i, f in enumerate(self.plan.faults)
+               if f.kind in ("kill_replica", "stall_replica")
+               and f.rank == self.rank and admitted >= f.step
+               and not self._already_fired(i)]
+        for idx, fault in due:
+            self._mark_fired(idx, fault)
+            if fault.kind == "stall_replica":
+                # the serving wedge: alive, beacons frozen — only the
+                # per-replica hang watchdog can end this
+                print(f"[chaos] replica {self.rank}: wedging serve loop "
+                      f"{fault.seconds}s ({in_flight} in flight)",
+                      file=sys.stderr, flush=True)
+                time.sleep(fault.seconds)
+            else:
+                self._fire_kill(fault)
+
+    def on_swap(self, checkpoint_path: str) -> bool:
+        """Fleet-side hook at the start of a checkpoint hot-swap:
+        ``corrupt_swap_checkpoint`` garbles the swap TARGET before any
+        replica loads it (``step``/``rank`` ignored — the swap is a
+        fleet-level event, and this injector's run_dir is the fleet dir).
+        Returns whether a fault fired, so the swap report can say the
+        abort was injected rather than organic."""
+        due = [(i, f) for i, f in enumerate(self.plan.faults)
+               if f.kind == "corrupt_swap_checkpoint"
+               and not self._already_fired(i)]
+        fired = False
+        for idx, fault in due:
+            self._mark_fired(idx, fault)
+            wrote = corrupt_checkpoint_payload(checkpoint_path)
+            print(f"[chaos] fleet: corrupted swap checkpoint "
+                  f"{checkpoint_path} (payload garbled: {wrote})",
+                  file=sys.stderr, flush=True)
+            fired = True
+        return fired
 
     def on_save(self, loop) -> None:
         """Right after a checkpoint save is SCHEDULED (async write in

@@ -47,6 +47,23 @@ Fault kinds (executed by :mod:`.inject`):
   directory still looks committed, but restore fails — the case the
   resume walk-back exists for).
 
+Serving-fleet faults (ISSUE 11; ``rank`` targets a REPLICA id, ``step``
+is an admitted-request threshold — serving has no optimizer steps):
+
+* ``kill_replica`` — the targeted replica's worker sends itself ``sig``
+  at the first scheduler tick where it has admitted >= ``step`` requests
+  AND at least one is still in flight (a replica dying mid-request: the
+  router must replay the in-flight requests on a sibling);
+* ``stall_replica`` — same trigger, but the worker WEDGES alive for
+  ``seconds`` (a stuck device / network stall): beacons freeze, so only
+  the per-replica hang watchdog can end it — the serving twin of
+  ``stall_step``;
+* ``corrupt_swap_checkpoint`` — fired FLEET-side at the next checkpoint
+  hot-swap: the swap target's payload is garbled before any replica
+  loads it, so the canary replica's validation must fail and the swap
+  must abort with every replica still serving the old weights (``step``
+  and ``rank`` are ignored — the swap is a fleet-level event).
+
 This module must stay import-light (no jax): the launcher and tests read
 plans before any backend initializes.
 """
@@ -63,7 +80,8 @@ __all__ = ["ChaosFault", "ChaosPlan", "CHAOS_PLAN_ENV"]
 CHAOS_PLAN_ENV = "DPT_CHAOS_PLAN"
 
 _KINDS = ("kill", "crash_in_save", "stall_data", "stall_step", "slow_rank",
-          "corrupt_checkpoint")
+          "corrupt_checkpoint",
+          "kill_replica", "stall_replica", "corrupt_swap_checkpoint")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,8 +104,8 @@ class ChaosFault:
                              f"(expected one of {_KINDS})")
         if self.step < 0:
             raise ValueError(f"chaos fault step must be >= 0, got {self.step}")
-        if self.kind in ("stall_data", "stall_step", "slow_rank") \
-                and self.seconds <= 0:
+        if self.kind in ("stall_data", "stall_step", "slow_rank",
+                         "stall_replica") and self.seconds <= 0:
             raise ValueError(f"{self.kind} fault needs seconds > 0")
         if self.kind == "slow_rank":
             if self.until_step < 0:
@@ -138,8 +156,9 @@ class ChaosPlan:
     def describe(self) -> str:
         return "; ".join(
             f"{f.kind}@step{f.step}/rank{f.rank}"
-            + (f" {f.sig}" if f.kind == "kill" else "")
-            + (f" {f.seconds}s" if f.kind in ("stall_data", "stall_step")
+            + (f" {f.sig}" if f.kind in ("kill", "kill_replica") else "")
+            + (f" {f.seconds}s" if f.kind in ("stall_data", "stall_step",
+                                              "stall_replica")
                else "")
             + (f" {f.seconds}s/step thru {f.until_step}"
                if f.kind == "slow_rank" else "")

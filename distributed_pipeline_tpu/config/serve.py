@@ -3,11 +3,15 @@
 Same declarative config surface as training (config/base.py): every field
 is a ``--flag``, round-trips through JSON, and documents itself in
 ``--help``. The knobs mirror the serving stack's layers — engine geometry
-(slots/pages/lengths), sampling, workload (prompt file or synthetic
-arrival process), and the sanitizer switch.
+(slots/pages/lengths), sampling, workload (prompt file or an arrival
+process), the sanitizer switch, and (ISSUE 11) the multi-replica fleet:
+traffic process, router health gates, per-replica supervision, and
+checkpoint hot-swap.
 """
 
 from __future__ import annotations
+
+from typing import Literal
 
 from .base import ArgparseCompatibleBaseModel as S
 from .base import item as _
@@ -61,8 +65,9 @@ class ServeSettings(S):
     synthetic_requests: int = _(32, "synthetic workload: request count")
     synthetic_prompt_len: int = _(0, "synthetic prompt length "
                                      "(0 = max_prompt_len)")
-    arrival_every_steps: int = _(0, "synthetic arrival process: enqueue "
-                                    "one request every N scheduler steps "
+    arrival_every_steps: int = _(0, "legacy step-cadence arrival knob "
+                                    "(traffic='steps' only): enqueue one "
+                                    "request every N scheduler steps "
                                     "(0 = all queued at start)")
     out: str = _("", "write per-request JSONL results here")
     sanitize: bool = _(False, "runtime sanitizer: count XLA compiles "
@@ -70,3 +75,70 @@ class ServeSettings(S):
                               "state — prefill/decode compile exactly "
                               "once) and disallow implicit host<->device "
                               "transfers during dispatch")
+    prefix_cache: bool = _(False, "shared-prefix KV page reuse: requests "
+                                  "whose prompts open with the same token "
+                                  "run share the paged-KV pages holding "
+                                  "that prefix (refcounted; evicted LRU "
+                                  "under pool pressure)")
+
+    # ------------------------------------------------- traffic (ISSUE 11)
+    traffic: Literal["steps", "poisson", "bursty", "diurnal"] = _(
+        "steps", "arrival process: 'steps' keeps the legacy "
+                 "scheduler-step cadence; poisson/bursty/diurnal are "
+                 "seeded wall-clock processes (serving/traffic.py) — "
+                 "same seed, same schedule, every process")
+    rate_rps: float = _(8.0, "mean arrival rate (requests/second) for the "
+                             "wall-clock traffic processes")
+    burst_every_s: float = _(2.0, "bursty traffic: seconds between bursts")
+    burst_size: int = _(8, "bursty traffic: arrivals per burst")
+    diurnal_period_s: float = _(30.0, "diurnal traffic: ramp period "
+                                      "(a compressed day/night cycle)")
+    diurnal_floor: float = _(0.2, "diurnal traffic: trough rate as a "
+                                  "fraction of rate_rps")
+    shared_prefix_len: int = _(0, "synthetic prompts open with this many "
+                                  "SHARED tokens (the prefix-cache "
+                                  "workload; 0 = fully random prompts)")
+
+    # --------------------------------------------------- fleet (ISSUE 11)
+    replicas: int = _(0, "serve through a fleet of N replicas (each its "
+                         "own supervised worker process behind the "
+                         "request router) instead of one in-process "
+                         "server; 0 = single-replica legacy path")
+    fleet_dir: str = _("", "fleet working dir (journal + per-replica "
+                           "run dirs); empty = <checkpoint_path>/fleet")
+    fleet_worker_dir: str = _("", "INTERNAL: run as a fleet replica "
+                                  "worker against this replica dir "
+                                  "(set by the fleet supervisor)")
+    replica_id: int = _(-1, "INTERNAL: this worker's replica index")
+    hang_timeout_s: float = _(10.0, "per-replica hang watchdog: a replica "
+                                    "whose beacons freeze this long is "
+                                    "SIGKILLed and its in-flight requests "
+                                    "replay on a sibling; must exceed the "
+                                    "slowest legitimate tick + swap-"
+                                    "restore gap. 0 disables")
+    fleet_max_restarts: int = _(3, "per-replica restart budget (sliding "
+                                   "window, launcher semantics)")
+    fleet_backoff_s: float = _(0.25, "per-replica restart backoff base")
+    stale_beacon_s: float = _(10.0, "router health gate: stop placing NEW "
+                                    "requests on a replica whose newest "
+                                    "beacon is older than this")
+    fleet_deadline_s: float = _(300.0, "hard wall-clock cap on the fleet "
+                                       "run; anything unfinished is "
+                                       "reported dropped (acceptance "
+                                       "is zero)")
+    chaos_plan: str = _("", "serving chaos schedule (JSON / @file; kinds "
+                            "kill_replica / stall_replica / "
+                            "corrupt_swap_checkpoint); also honors the "
+                            "DPT_CHAOS_PLAN env like training")
+
+    # ------------------------------------------------ hot-swap (ISSUE 11)
+    swap_after_requests: int = _(0, "trigger a zero-downtime checkpoint "
+                                    "hot-swap once this many requests "
+                                    "have completed (0 = no swap)")
+    swap_step: int = _(0, "hot-swap target step (0 = newest finalized "
+                          "checkpoint at swap time)")
+    drain_timeout_s: float = _(60.0, "hot-swap: max wait for one "
+                                     "replica's outstanding requests to "
+                                     "finish before the swap aborts")
+    swap_timeout_s: float = _(120.0, "hot-swap: max wait for one replica "
+                                     "to load + ack the new checkpoint")

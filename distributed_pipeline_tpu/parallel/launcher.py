@@ -284,7 +284,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      hang_timeout_s: float = 0.0,
                      hang_startup_timeout_s: float = 0.0,
                      run_dir_file: str = "",
-                     status: Optional[dict] = None) -> int:
+                     status: Optional[dict] = None,
+                     tag: str = "") -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -308,16 +309,17 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     """
     port = find_free_port()
     coord = f"127.0.0.1:{port}"
-    print(f"[launcher] attempt {attempt}: spawning {nprocs} local workers, "
+    label = f"[launcher{' ' + tag if tag else ''}]"
+    print(f"{label} attempt {attempt}: spawning {nprocs} local workers, "
           f"coordinator {coord}")
-    print(f"[launcher] worker cmd: {' '.join(cmd_base)}")  # cmdline echo,
+    print(f"{label} worker cmd: {' '.join(cmd_base)}")  # cmdline echo,
     # like reference dist_run.py:36-44
     logs = []
     tee_threads = []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
         mode = "tee'd to console and" if log_tee else "->"
-        print(f"[launcher] per-worker output {mode} "
+        print(f"{label} per-worker output {mode} "
               f"{log_dir}/worker_N.log")
     procs = []
     # The spawn loop sits INSIDE the try: if opening worker k's log or its
@@ -370,7 +372,7 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                     codes[i] = p.poll()
             failed = [i for i, c in enumerate(codes) if c not in (None, 0)]
             if failed:
-                print(f"[launcher] worker(s) {failed} exited with "
+                print(f"{label} worker(s) {failed} exited with "
                       f"{[codes[i] for i in failed]}; terminating remaining workers")
                 for i, p in enumerate(procs):
                     if codes[i] is None:
@@ -404,7 +406,7 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                     hung_kind = "startup"
                 if hung_kind:
                     hang_s = now - (last_advance if hang_armed else t_start)
-                    print(f"[launcher] hang watchdog: no rank advanced for "
+                    print(f"{label} hang watchdog: no rank advanced for "
                           f"{hang_s:.1f}s "
                           f"({'no first beacon' if hung_kind == 'startup' else 'beacons frozen'}); "
                           f"SIGKILLing the worker ring")
@@ -503,6 +505,7 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
     end_step: Optional[int] = None
     start_step = prev_max_step
     beacon_goodput = None
+    serving_snap = None
     resume_overhead = None
     recompiles = steady_recompiles = None
     if run_dir and os.path.isdir(run_dir):
@@ -533,6 +536,12 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
             # died before writing its clean-exit sidecar)
             b0 = ours.get(0) or next(iter(ours.values()))
             beacon_goodput = b0.get("goodput")
+            # serving replicas beacon a `serving` snapshot instead of a
+            # training goodput one — harvest it the same way, so a killed
+            # replica attempt keeps its flight recorder (aggregate_serving
+            # falls back to it when no clean-exit sidecar exists)
+            snap = b0.get("serving")
+            serving_snap = snap if isinstance(snap, dict) else None
             recompiles = b0.get("recompile_count")
             steady_recompiles = b0.get("steady_recompile_count")
             if isinstance(beacon_goodput, dict):
@@ -558,6 +567,8 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
         "steady_recompile_count": steady_recompiles,
         "goodput": beacon_goodput,
     }
+    if serving_snap is not None:
+        record["serving"] = serving_snap
     if nprocs is not None:
         # The attempt's actual topology (elastic runs shrink/grow between
         # attempts): what aggregate/debug tooling needs to attribute a
@@ -583,7 +594,9 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             restart_backoff_s: float = 1.0,
                             restart_backoff_max_s: float = 30.0,
                             hang_timeout_s: float = 0.0,
-                            hang_startup_timeout_s: float = 0.0) -> int:
+                            hang_startup_timeout_s: float = 0.0,
+                            extra_env: Optional[dict] = None,
+                            tag: str = "") -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
@@ -615,6 +628,14 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     ``hang_timeout_s`` arms the per-attempt HANG WATCHDOG (see
     :func:`_run_worker_ring`): silently wedged attempts are killed and
     restarted instead of burning the budgeted wall time forever.
+
+    ``extra_env`` reaches every worker of every attempt (launcher-owned
+    keys — DPT_ATTEMPT, ring coordinates, DPT_RUN_DIR_FILE — always win);
+    ``tag`` prefixes this supervisor's log lines, so N rings supervised
+    concurrently from one process (the serving fleet runs one per
+    replica, in threads) stay attributable. This function is
+    thread-safe: all state is local, and the per-ring run-dir handshake
+    file is a fresh tempfile per call.
 
     Reference equivalent: in-process ``torch.distributed.run.run``
     (dist_run.py:13-54). Returns the final attempt's max worker exit code.
@@ -650,6 +671,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
         os.environ.get(FORCE_DEVICES_ENV, ""))
     fd, run_dir_file = tempfile.mkstemp(prefix="dpt_run_dir_")
     os.close(fd)
+    label = f"[launcher{' ' + tag if tag else ''}]"
     records: List[dict] = []
     attempt = 0
     consecutive_failures = 0
@@ -662,7 +684,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
             devices_a = _capacity_at(devices_sched, attempt,
                                      devices_per_proc)
             if nprocs_a != nprocs or devices_a != devices_per_proc:
-                print(f"[launcher] attempt {attempt}: capacity override "
+                print(f"{label} attempt {attempt}: capacity override "
                       f"-> {nprocs_a} worker(s) x {devices_a} device(s) "
                       f"(was {nprocs} x {devices_per_proc})")
             ring_status: dict = {}
@@ -670,13 +692,14 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                 cmd_base, nprocs_a, devices_a, monitor_interval,
                 run_timestamp, log_dir=log_dir, log_tee=log_tee,
                 cache_dir=cache_dir, attempt=attempt,
-                extra_env={"DPT_ATTEMPT": str(attempt),
+                extra_env={**(extra_env or {}),
+                           "DPT_ATTEMPT": str(attempt),
                            "DPT_SPAWN_T": repr(t_spawn),
                            "DPT_RUN_DIR_FILE": run_dir_file},
                 hang_timeout_s=hang_timeout_s,
                 hang_startup_timeout_s=hang_startup_timeout_s,
                 run_dir_file=run_dir_file,
-                status=ring_status)
+                status=ring_status, tag=tag)
             t_exit = time.time()
             record, run_dir = _harvest_attempt(
                 run_dir_file, attempt, code, t_spawn, t_exit, prev_t_exit,
@@ -687,7 +710,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                 try:
                     goodput_lib.append_attempt(run_dir, record)
                 except OSError as e:
-                    print(f"[launcher] attempts.jsonl write failed: {e}")
+                    print(f"{label} attempts.jsonl write failed: {e}")
             prev_t_exit = t_exit
             if record["end_step"] is not None:
                 prev_max_step = max(prev_max_step or 0, record["end_step"])
@@ -702,7 +725,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
             else:
                 consecutive_failures += 1
             if _crash_looping(records):
-                print(f"[launcher] crash loop: last 2 attempts made zero "
+                print(f"{label} crash loop: last 2 attempts made zero "
                       f"step progress (rc={code}); failing fast instead of "
                       f"burning {max_restarts - budget.spent()} more "
                       f"restart(s)")
@@ -710,7 +733,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
             if not budget.allows_restart():
                 window = (f"in the last {restart_window_s:.0f}s"
                           if restart_window_s > 0 else "total")
-                print(f"[launcher] ring failed (rc={code}); restart budget "
+                print(f"{label} ring failed (rc={code}); restart budget "
                       f"exhausted ({budget.spent()}/{max_restarts} "
                       f"{window})")
                 return code
@@ -721,7 +744,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                               restart_backoff_s
                               * (2.0 ** (consecutive_failures - 1)))
             attempt += 1
-            print(f"[launcher] ring failed (rc={code}); restart "
+            print(f"{label} ring failed (rc={code}); restart "
                   f"{budget.spent()}/{max_restarts} (window "
                   f"{restart_window_s:.0f}s), backoff {backoff:.1f}s")
             if backoff > 0:

@@ -59,18 +59,43 @@ def create_parser() -> argparse.ArgumentParser:
     return p
 
 
+def restore_target(wl, mesh=None):
+    """Abstract params tree carrying CONCRETE shardings for THIS
+    process's devices (mesh layout per the model's logical rules, or
+    single-device without a mesh) — the orbax restore target. Without
+    concrete shardings, orbax falls back to the sharding file written at
+    save time, which only resolves on the WRITER's topology (a serving
+    replica with one device could not load a dp=8 training checkpoint;
+    the same cross-topology contract as the elastic resume path). One
+    owner for every checkpoint consumer: initial load (:func:`load_run`)
+    and the serving fleet's hot-swap restore."""
+    import jax
+    from flax import linen as nn
+
+    from ..parallel.sharding import param_shardings
+
+    boxed = jax.eval_shape(wl.init_params, jax.random.PRNGKey(0))
+    abstract = nn.meta.unbox(boxed)
+    if mesh is not None:
+        shardings = param_shardings(mesh, boxed)
+    else:
+        dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        shardings = jax.tree_util.tree_map(lambda _: dev, abstract)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, shardings)
+
+
 def load_run(run_dir: str, step: int = 0, ema: str = "", mesh=None):
     """Recover (workload, params, targs, step, which) from a run directory:
     model config from its ``training_args.json`` snapshot, raw or EMA
     params from the newest (or explicit-step) checkpoint. With ``mesh``,
     params land sharded per the model's logical rules (FSDP/TP), so every
-    chip holds its shard instead of device 0 holding everything. Shared by
+    chip holds its shard instead of device 0 holding everything (see
+    :func:`restore_target` for the cross-topology contract). Shared by
     ``run.sample`` and ``run.serve`` — one loading (and placement) path
     for every checkpoint consumer."""
-    import jax
-
     from ..models import create_model_from_config
-    from ..parallel.sharding import param_shardings
     from ..utils import checkpoint as ckpt_lib
     from ..utils import logger
 
@@ -79,9 +104,7 @@ def load_run(run_dir: str, step: int = 0, ema: str = "", mesh=None):
         targs = json.load(f)
 
     wl = create_model_from_config(**targs)
-    boxed = jax.eval_shape(wl.init_params, jax.random.PRNGKey(0))
-    from flax import linen as nn
-    abstract = nn.meta.unbox(boxed)
+    abstract = restore_target(wl, mesh)
 
     if step:
         model_path = os.path.join(run_dir, f"model_{step:06d}")
@@ -100,8 +123,8 @@ def load_run(run_dir: str, step: int = 0, ema: str = "", mesh=None):
     else:
         params = ckpt_lib.restore_checkpoint(model_path, abstract)
         which = "raw"
-    if mesh is not None:
-        params = jax.device_put(params, param_shardings(mesh, boxed))
+    # no post-restore device_put: the abstract target's shardings already
+    # placed the tree (mesh layout or single-device) during restore
     logger.info(f"loaded {which} params from step {step} ({model_path})")
     return wl, params, targs, step, which
 

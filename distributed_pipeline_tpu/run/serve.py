@@ -1,25 +1,41 @@
-"""Serving entry point: continuous-batching decode over a trained run.
+"""Serving entry point: continuous-batching decode, single replica or fleet.
 
 ``run/sample.py`` is a one-shot batch script — it decodes N fixed batches
-and exits. This entry serves TRAFFIC: requests (a JSONL prompt file or a
-synthetic arrival process) stream through a :class:`serving.DecodeServer`
-whose compiled decode batch stays continuously full — prefill/decode as
-separately AOT-compiled executables over the paged KV cache, free slots
-re-admitting queued requests every step (ROADMAP open item 1).
+and exits. This entry serves TRAFFIC, in three modes:
+
+* SINGLE (default): requests (a JSONL prompt file or a synthetic
+  workload) stream through one in-process :class:`serving.DecodeServer`
+  — prefill/decode as separately AOT-compiled executables over the paged
+  KV cache, free slots re-admitting queued requests every step. Arrivals
+  come from the legacy step-cadence knob (``--traffic steps``) or a
+  seeded wall-clock process (``--traffic poisson|bursty|diurnal``).
+* FLEET (``--replicas N``, ISSUE 11): N replica WORKER processes — each
+  its own supervised launcher ring with restart budget/backoff and the
+  beacon-mtime hang watchdog — behind a health-gated, load-aware request
+  router with a durable journal: in-flight requests on a killed/wedged
+  replica replay on a sibling, and ``--swap_after_requests`` rolls a
+  newer checkpoint through the fleet with zero downtime (>= N-1 replicas
+  serving at every instant; a corrupt target aborts on the canary). The
+  fleet parent process never imports jax.
+* WORKER (internal, ``--fleet_worker_dir``): one replica — loads the
+  checkpoint, serves its inbox, beacons every tick, executes hot-swap
+  commands, and writes the serving goodput sidecar.
 
     python -m distributed_pipeline_tpu.run.serve --checkpoint_path RUNDIR \
         --decode_slots 64 --page_size 16 --max_new_tokens 128
     python -m distributed_pipeline_tpu.run.serve --checkpoint_path RUNDIR \
-        --prompt_file prompts.jsonl --out results.jsonl --sanitize true
+        --replicas 3 --traffic poisson --rate_rps 8 --synthetic_requests 64
 
 stdout carries one machine-readable JSON summary (throughput, TTFT
-percentiles, compile split, recompile count); progress goes to stderr.
+percentiles, compile split, recompile count; fleet mode adds replay/swap/
+goodput-ledger fields); progress goes to stderr.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -56,6 +72,20 @@ def _load_requests(settings: ServeSettings, max_prompt_len: int,
                             int(row.get("max_new_tokens",
                                         settings.max_new_tokens))))
         return out
+    if settings.traffic != "steps" or settings.shared_prefix_len > 0:
+        # traffic-process synthetic workload: prompts come from the same
+        # seeded generator as the schedule (deterministic cross-process)
+        from ..serving.traffic import TrafficGenerator
+
+        gen = _generator(settings, default="poisson")
+        plen = min(settings.synthetic_prompt_len or max_prompt_len,
+                   max_prompt_len)
+        reqs = gen.requests(settings.synthetic_requests,
+                            vocab_size=vocab_size, prompt_len=plen,
+                            max_new_tokens=settings.max_new_tokens,
+                            shared_prefix_len=min(
+                                settings.shared_prefix_len, plen))
+        return [(r.prompt, r.max_new_tokens) for r in reqs]
     rng = np.random.default_rng(settings.seed)
     plen = min(settings.synthetic_prompt_len or max_prompt_len,
                max_prompt_len)
@@ -63,8 +93,32 @@ def _load_requests(settings: ServeSettings, max_prompt_len: int,
             for _ in range(settings.synthetic_requests)]
 
 
-def main(ns: argparse.Namespace) -> dict:
-    settings = ServeSettings.from_argparse(ns)
+def _generator(settings: ServeSettings, default: str = "poisson"):
+    """The settings' traffic process as a TrafficGenerator ('steps' maps
+    to ``default`` — fleet mode has no scheduler-step clock to count)."""
+    from ..serving.traffic import TrafficGenerator
+
+    process = settings.traffic if settings.traffic != "steps" else default
+    return TrafficGenerator(
+        process, settings.rate_rps, settings.seed,
+        burst_every_s=settings.burst_every_s,
+        burst_size=settings.burst_size,
+        diurnal_period_s=settings.diurnal_period_s,
+        diurnal_floor=settings.diurnal_floor)
+
+
+def _resolve_chaos_plan(settings: ServeSettings):
+    """--chaos_plan flag or the DPT_CHAOS_PLAN env (the launcher channel
+    training uses); None when neither is set."""
+    from ..chaos import CHAOS_PLAN_ENV, ChaosPlan
+
+    src = settings.chaos_plan or os.environ.get(CHAOS_PLAN_ENV, "")
+    return ChaosPlan.parse(src) if src else None
+
+
+# =========================================================== single replica
+
+def _serve_single(settings: ServeSettings) -> dict:
     import numpy as np
 
     from ..parallel import make_mesh
@@ -88,12 +142,18 @@ def main(ns: argparse.Namespace) -> dict:
         temperature=settings.temperature, top_k=settings.top_k,
         top_p=settings.top_p, seed=settings.seed,
         eos_id=settings.eos_id if settings.eos_id >= 0 else None,
-        mesh=mesh, sanitize=settings.sanitize)
+        mesh=mesh, sanitize=settings.sanitize,
+        prefix_cache=settings.prefix_cache)
 
     pending = _load_requests(settings, max_prompt_len, wl.model.vocab_size)
     logger.info(f"serving {len(pending)} requests on {settings.decode_slots} "
                 f"slots (page_size={settings.page_size}, "
                 f"pool={server.mgr.num_pages} pages)")
+
+    # wall-clock arrival schedule (the synthetic-arrival-knob replacement);
+    # None keeps the legacy per-N-steps cadence
+    offsets = (None if settings.traffic == "steps"
+               else _generator(settings).schedule(len(pending)))
 
     t0 = time.perf_counter()
     submitted = []
@@ -104,13 +164,25 @@ def main(ns: argparse.Namespace) -> dict:
     # growth past this snapshot is a steady-state recompile — the
     # regression the gauge exists to catch
     try:  # submits included: a bad request must still stop_sanitizer
-        if cadence <= 0:  # saturating workload: everything queued up front
+        if offsets is None and cadence <= 0:
+            # saturating workload: everything queued up front
             for prompt, n in pending:
                 submitted.append(server.submit(
                     prompt, n or settings.max_new_tokens))
             pending = []
         while pending or server.busy:
-            if pending and steps % cadence == 0:
+            if offsets is not None:
+                now = time.perf_counter() - t0
+                while pending and offsets[len(submitted)] <= now:
+                    prompt, n = pending.pop(0)
+                    submitted.append(server.submit(
+                        prompt, n or settings.max_new_tokens))
+                if pending and not server.busy:
+                    # idle gap before the next arrival: sleep it off
+                    # instead of spinning no-op scheduler ticks
+                    time.sleep(min(max(0.0, offsets[len(submitted)] - now),
+                                   0.005))
+            elif pending and cadence > 0 and steps % cadence == 0:
                 prompt, n = pending.pop(0)
                 submitted.append(server.submit(
                     prompt, n or settings.max_new_tokens))
@@ -148,9 +220,11 @@ def main(ns: argparse.Namespace) -> dict:
         "prefill_steps": server.prefill_steps,
         "decode_slots": settings.decode_slots,
         "page_size": settings.page_size,
+        "traffic": settings.traffic,
         "compile_time_s": round(server.compile_time_s, 3),
         "wall_s": round(wall_s, 2),
     }
+    result.update(server.prefix_stats())
     if settings.sanitize:
         # steady-state growth past the warm snapshot must be 0: the two
         # phase executables compile exactly once, during warmup
@@ -160,6 +234,349 @@ def main(ns: argparse.Namespace) -> dict:
         result["xla_compiles_total"] = recompiles
     print(json.dumps(result))
     return result
+
+
+# ============================================================ fleet worker
+
+def _fleet_worker_main(settings: ServeSettings) -> dict:
+    """One replica: serve the inbox until told to stop. Runs under a
+    supervising launcher ring — beacons every tick (hang-watchdog
+    liveness + kill flight recorder), clears stale inbox entries at
+    startup (the router replays them), executes hot-swap commands with a
+    local drain, and books drain/swap time so the fleet goodput ledger
+    accounts every second."""
+    import numpy as np
+
+    from ..chaos import ChaosInjector, ChaosPlan
+    from ..parallel import make_mesh
+    from ..serving import DecodeServer
+    from ..serving.fleet import ReplicaPaths, WorkerProtocol
+    from ..utils import checkpoint as ckpt_lib
+    from .sample import load_run
+
+    rid = settings.replica_id
+    paths = ReplicaPaths.at(settings.fleet_worker_dir, rid)
+    proto = WorkerProtocol(paths, rid)
+    pin = proto.startup()  # inbox cleared; params pin from a prior swap
+
+    plan = _resolve_chaos_plan(settings)
+    injector = (ChaosInjector(plan, rank=rid, run_dir=paths.root)
+                if plan else None)
+
+    step = int(pin["step"]) if pin else settings.step
+    mesh = make_mesh()
+    wl, params, _targs, step, _which = load_run(
+        settings.checkpoint_path, step, settings.ema, mesh=mesh)
+    # abstract restore target for hot-swap restores: the SAME concrete-
+    # sharding construction the initial load used (one owner —
+    # run/sample.restore_target), so a swapped tree restores on any
+    # replica topology AND meets the pinned AOT signature exactly
+    from .sample import restore_target
+    abstract = restore_target(wl, mesh)
+
+    max_len = settings.max_len or wl.seq_len
+    max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
+    server = DecodeServer(
+        wl, params, decode_slots=settings.decode_slots,
+        page_size=settings.page_size, max_pages=settings.max_pages,
+        max_prompt_len=max_prompt_len, max_len=max_len,
+        prefill_batch=settings.prefill_batch,
+        decode_span=settings.decode_span,
+        dispatch_lag=settings.dispatch_lag,
+        temperature=settings.temperature, top_k=settings.top_k,
+        top_p=settings.top_p, seed=settings.seed,
+        eos_id=settings.eos_id if settings.eos_id >= 0 else None,
+        mesh=mesh, sanitize=settings.sanitize,
+        prefix_cache=settings.prefix_cache)
+
+    def _restore_params(target: str):
+        # the abstract target's shardings place the tree during restore
+        return ckpt_lib.restore_checkpoint(target, abstract)
+
+    # Warmup BEFORE announcing ready: the prefill/decode AOT compiles run
+    # here, so the first routed request's TTFT is service time, not
+    # compile time — and the watchdog (armed by the FIRST beacon) never
+    # sees compilation as a hang.
+    warm = server.submit(np.full((2,), 4, np.int32), max_new_tokens=1)
+    server.drain()
+    del warm
+    server.reset_stats()
+
+    tick = 0
+    admitted = 0
+    in_flight = {}  # router req id -> (server Request, inbox payload)
+    completed = 0
+    tokens_out = 0
+    current_step = [step]
+    proto.write_beacon(tick)
+    proto.announce_ready(step)
+    print(f"[serve-worker {rid}] ready at step {step} "
+          f"(attempt {proto.attempt})", file=sys.stderr, flush=True)
+
+    def _report_done() -> None:
+        nonlocal completed, tokens_out
+        for rk, (req, payload) in list(in_flight.items()):
+            if not req.finished:
+                continue
+            # TTFT relative to the ROUTER's submit stamp: queue wait and
+            # any replay delay are inside the number a user feels
+            ttft = None
+            if req.ttft_s is not None:
+                lag = payload["_t_local"] - float(
+                    payload.get("submit_t", payload["_t_local"]))
+                ttft = max(0.0, lag) + req.ttft_s
+            proto.write_result({
+                "id": int(payload["id"]),
+                "tokens": [int(t) for t in req.tokens],
+                "ttft_s": ttft, "params_step": current_step[0],
+                "replays": int(payload.get("replays", 0))})
+            completed += 1
+            tokens_out += len(req.tokens)
+            del in_flight[rk]
+
+    def _handle_swap(cmd: dict) -> None:
+        # local drain first (belt over the router's braces: placement is
+        # already off, but anything in flight finishes on the OLD params)
+        nonlocal tick
+        with proto.tracker.timed("drain_s"):
+            while server.busy:
+                server.step()
+                tick += 1
+                proto.write_beacon(tick)
+        _report_done()
+        with proto.tracker.timed("swap_s"):
+            proto.write_beacon(tick)  # restore time is not a hang
+            try:
+                server.engine.params = _restore_params(cmd["target"])
+                ok, err = True, ""
+            except Exception as e:  # corrupt/missing payload: keep old
+                ok, err = False, f"{type(e).__name__}: {e}"
+            proto.write_beacon(tick)
+        if ok:
+            current_step[0] = int(cmd["step"])
+            proto.announce_ready(current_step[0])
+        proto.ack_swap(int(cmd["id"]), ok, current_step[0], err)
+        print(f"[serve-worker {rid}] swap -> step {cmd['step']}: "
+              f"{'ok' if ok else err}", file=sys.stderr, flush=True)
+
+    try:
+        while not proto.stop_requested():
+            cmd = proto.pending_swap()
+            if cmd is not None:
+                _handle_swap(cmd)
+            if injector is not None:
+                injector.on_serve_tick(admitted, len(in_flight))
+            moved = False
+            for payload in proto.poll_inbox():
+                try:
+                    req = server.submit(
+                        np.asarray(payload["prompt"], np.int32),
+                        int(payload["max_new_tokens"]))
+                except ValueError as e:
+                    proto.write_result({"id": int(payload["id"]),
+                                        "tokens": [], "ttft_s": None,
+                                        "error": str(e)})
+                    proto.consume(int(payload["id"]))
+                    continue
+                payload["_t_local"] = time.time()
+                in_flight[int(payload["id"])] = (req, payload)
+                proto.consume(int(payload["id"]))
+                admitted += 1
+                moved = True
+            if server.busy:
+                server.step()
+                moved = True
+            _report_done()
+            tick += 1
+            proto.write_beacon(tick)
+            if not moved:
+                time.sleep(0.005)
+    finally:
+        server.stop_sanitizer()
+    # graceful stop: drain whatever is still in flight before exiting 0
+    with proto.tracker.timed("drain_s"):
+        while server.busy:
+            server.step()
+            tick += 1
+            proto.write_beacon(tick)
+    _report_done()
+    summary = {"ticks": tick, "admitted": admitted, "completed": completed,
+               "tokens": tokens_out, "params_step": current_step[0],
+               **server.prefix_stats()}
+    proto.write_sidecar(summary)
+    print(f"[serve-worker {rid}] stopping: {json.dumps(summary)}",
+          file=sys.stderr, flush=True)
+    return summary
+
+
+# ========================================================= fleet supervisor
+
+def _fleet_main(settings: ServeSettings) -> dict:
+    """N replicas behind the router, driven by a wall-clock traffic
+    process; optional mid-run checkpoint hot-swap; serving goodput ledger
+    at exit. This process stays jax-free — replicas pay the backend."""
+    import numpy as np
+
+    from ..chaos import CHAOS_PLAN_ENV, ChaosInjector, goodput
+    from ..serving.fleet import ServingFleet
+    from ..serving.router import Router
+
+    targs_file = os.path.join(settings.checkpoint_path,
+                              "training_args.json")
+    with open(targs_file) as f:
+        targs = json.load(f)
+    vocab = int(targs["vocab_size"])
+    seq_len = int(targs["seq_len"])
+    max_len = settings.max_len or seq_len
+    max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
+
+    fleet_dir = settings.fleet_dir or os.path.join(
+        settings.checkpoint_path, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+
+    plan = _resolve_chaos_plan(settings)
+    if plan is not None:
+        # serving faults ride the env to every replica worker of every
+        # attempt (the same channel training chaos uses); the fleet-level
+        # injector only executes corrupt_swap_checkpoint
+        os.environ[CHAOS_PLAN_ENV] = plan.to_json()
+    injector = (ChaosInjector(plan, rank=0, run_dir=fleet_dir)
+                if plan else None)
+
+    # worker argv: every serve setting EXCEPT the fleet-parent-only knobs
+    # (the fleet appends --fleet_worker_dir/--replica_id per replica)
+    parent_only = {"replicas", "fleet_dir", "fleet_worker_dir",
+                   "replica_id", "out", "prompt_file"}
+    argv = []
+    for name in type(settings).model_fields:
+        if name in parent_only:
+            continue
+        value = getattr(settings, name)
+        argv += [f"--{name}", str(value)]
+
+    fleet = ServingFleet(
+        fleet_dir, settings.replicas,
+        "distributed_pipeline_tpu.run.serve", argv,
+        devices_per_proc=1,
+        hang_timeout_s=settings.hang_timeout_s,
+        max_restarts=settings.fleet_max_restarts,
+        restart_backoff_s=settings.fleet_backoff_s)
+    fleet.start()
+    router = Router(fleet.clients(), goodput.serving_journal_path(fleet_dir),
+                    stale_beacon_s=settings.stale_beacon_s)
+
+    gen = _generator(settings, default="poisson")
+    if settings.prompt_file:
+        pairs = _load_requests(settings, max_prompt_len, vocab)
+        offsets = gen.schedule(len(pairs))
+        reqs = [(float(offsets[i]), p, n or settings.max_new_tokens)
+                for i, (p, n) in enumerate(pairs)]
+    else:
+        plen = min(settings.synthetic_prompt_len or max_prompt_len,
+                   max_prompt_len)
+        reqs = [(r.t, r.prompt, r.max_new_tokens)
+                for r in gen.requests(
+                    settings.synthetic_requests, vocab_size=vocab,
+                    prompt_len=plen,
+                    max_new_tokens=settings.max_new_tokens,
+                    shared_prefix_len=min(settings.shared_prefix_len,
+                                          plen))]
+    print(f"# fleet: {settings.replicas} replicas, {len(reqs)} requests, "
+          f"traffic {gen.describe()}", file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    swap_report = None
+    swap_armed = False
+    next_idx = 0
+    deadline_hit = False
+    try:
+        while True:
+            elapsed = time.perf_counter() - t0
+            while next_idx < len(reqs) and reqs[next_idx][0] <= elapsed:
+                _, prompt, mnt = reqs[next_idx]
+                router.submit(prompt, mnt, submit_t=time.time())
+                next_idx += 1
+            router.poll()
+            if fleet.swap_active:
+                rep = fleet.step_swap(router)
+                if rep is not None:
+                    swap_report = rep
+                    print(f"# fleet: swap "
+                          f"{'ok' if rep['ok'] else 'ABORTED'}: "
+                          f"{rep.get('error') or rep['step']}",
+                          file=sys.stderr, flush=True)
+            elif (not swap_armed and settings.swap_after_requests > 0
+                  and router.completed >= settings.swap_after_requests):
+                swap_armed = True
+                try:
+                    arm = fleet.begin_hot_swap(
+                        settings.checkpoint_path, settings.swap_step,
+                        drain_timeout_s=settings.drain_timeout_s,
+                        swap_timeout_s=settings.swap_timeout_s,
+                        injector=injector)
+                    print(f"# fleet: hot-swap armed -> {arm['target']}",
+                          file=sys.stderr, flush=True)
+                except (FileNotFoundError, RuntimeError) as e:
+                    swap_report = {"ok": False,
+                                   "error": f"arm failed: {e}"}
+            if (next_idx >= len(reqs) and router.all_done()
+                    and not fleet.swap_active):
+                break
+            if elapsed > settings.fleet_deadline_s:
+                deadline_hit = True
+                break
+            time.sleep(0.01)
+    finally:
+        rcs = fleet.stop()
+    wall_s = time.perf_counter() - t0
+
+    records = sorted(router.records.values(), key=lambda r: r.id)
+    if settings.out:
+        with open(settings.out, "w") as f:
+            for rec in records:
+                f.write(json.dumps({
+                    "id": rec.id, "prompt": rec.prompt.tolist(),
+                    "tokens": rec.tokens, "replica": rec.replica,
+                    "replays": rec.replays,
+                    "ttft_s": round(rec.ttft_s or 0.0, 4)}) + "\n")
+
+    ttfts = router.ttfts()
+    tokens = sum(len(r.tokens) for r in records if r.state == "done")
+    agg = goodput.aggregate_serving(fleet_dir)
+    dropped = router.submitted - router.completed
+    result = {
+        "mode": "fleet",
+        "replicas": settings.replicas,
+        "traffic": gen.describe(),
+        "requests": router.submitted,
+        "completed": router.completed,
+        "dropped": dropped,
+        "replayed": router.replayed,
+        "deadline_hit": deadline_hit,
+        "decode_tokens": tokens,
+        "decode_tokens_per_s": round(tokens / max(wall_s, 1e-9), 1),
+        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4)
+        if ttfts else None,
+        "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 4)
+        if ttfts else None,
+        "swap": swap_report,
+        "replica_rcs": rcs,
+        "wall_s": round(wall_s, 2),
+        "serving_goodput": {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in agg.items() if k != "per_replica"},
+    }
+    print(json.dumps(result))
+    return result
+
+
+def main(ns: argparse.Namespace) -> dict:
+    settings = ServeSettings.from_argparse(ns)
+    if settings.fleet_worker_dir:
+        return _fleet_worker_main(settings)
+    if settings.replicas > 0:
+        return _fleet_main(settings)
+    return _serve_single(settings)
 
 
 if __name__ == "__main__":

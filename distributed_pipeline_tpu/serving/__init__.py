@@ -1,30 +1,71 @@
-"""Serving subsystem: prefill/decode AOT split, paged KV cache, and a
-continuous-batching scheduler (ROADMAP open item 1 — the "millions of
-users, heavy traffic" direction).
+"""Serving subsystem: prefill/decode AOT split, paged KV cache, a
+continuous-batching scheduler, and the multi-replica resilience layer
+(ROADMAP open item 2 — the "millions of users, heavy traffic" direction).
 
 Layers, bottom up:
 
 * :mod:`.paged_kv`   — pure-XLA page ops (scatter/gather against a shared
-  page pool + block tables) and the host-side :class:`PageManager`;
+  page pool + block tables), the host-side :class:`PageManager`, and the
+  refcounted :class:`PrefixCache` (requests sharing a prompt prefix reuse
+  paged-KV pages);
 * :mod:`.engine`     — :class:`DecodeEngine`: ``prefill`` and
   ``decode_step`` as two separately AOT-compiled executables with pinned
   shardings and per-slot positions, over the paged cache;
 * :mod:`.scheduler`  — :class:`DecodeServer`: continuous batching (admit
   into free slots every step, decode always at the compiled slot count),
   count-based completion, lagged token fetch so host bookkeeping overlaps
-  device steps, and TTFT/throughput gauges.
+  device steps, and TTFT/throughput gauges;
+* :mod:`.traffic`    — seeded, deterministic arrival-process generators
+  (Poisson / bursty / diurnal) for SLO-under-load benches;
+* :mod:`.fleet`      — replica file protocol + :class:`ServingFleet`:
+  N replicas, each its own supervised launcher ring (restart budget,
+  backoff, beacon-mtime hang watchdog), plus zero-downtime checkpoint
+  hot-swap;
+* :mod:`.router`     — :class:`Router`: health-gated, load-aware
+  placement with a durable request journal; in-flight requests on a dead
+  or wedged replica replay on a sibling.
 
-Entry points: ``run/serve.py`` serves a prompt stream; ``run/sample.py``
-routes one-shot GPT-2 decoding through :func:`one_shot_decode` — one code
-path for one-shot and served decode.
+Entry points: ``run/serve.py`` serves a prompt stream (single replica or
+``--replicas N`` fleet); ``run/sample.py`` routes one-shot GPT-2 decoding
+through :func:`one_shot_decode` — one code path for one-shot and served
+decode.
+
+This ``__init__`` is LAZY (PEP 562): ``traffic``/``fleet``/``router`` are
+jax-free on purpose — the fleet supervisor and router run in a process
+that never imports jax (only replica workers pay it) — so the package
+must not import the jax-heavy engine/scheduler until someone asks for
+those names.
 """
 
-from .engine import DecodeEngine
-from .paged_kv import TRASH_PAGE, PageManager, gather_kv, write_prompt_kv, \
-    write_token_kv
-from .scheduler import DecodeServer, Request, one_shot_decode
+_LAZY = {
+    "DecodeEngine": ".engine",
+    "TRASH_PAGE": ".paged_kv",
+    "PageManager": ".paged_kv",
+    "PrefixCache": ".paged_kv",
+    "gather_kv": ".paged_kv",
+    "write_prompt_kv": ".paged_kv",
+    "write_token_kv": ".paged_kv",
+    "DecodeServer": ".scheduler",
+    "Request": ".scheduler",
+    "one_shot_decode": ".scheduler",
+    "TrafficGenerator": ".traffic",
+    "ServingFleet": ".fleet",
+    "Router": ".router",
+}
 
-__all__ = [
-    "DecodeEngine", "DecodeServer", "Request", "PageManager", "TRASH_PAGE",
-    "gather_kv", "write_prompt_kv", "write_token_kv", "one_shot_decode",
-]
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        value = getattr(mod, name)
+        globals()[name] = value  # cache: next access skips this hook
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

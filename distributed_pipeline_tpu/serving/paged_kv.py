@@ -37,13 +37,14 @@ strand), while the device only ever sees table CONTENTS as data.
 
 from __future__ import annotations
 
-from typing import List, Optional
+import collections
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["TRASH_PAGE", "gather_kv", "write_prompt_kv", "write_token_kv",
-           "PageManager"]
+           "PageManager", "PrefixCache"]
 
 TRASH_PAGE = 0  # reserved: masked/invalid writes land here, reads never do
 
@@ -144,3 +145,168 @@ class PageManager:
                 raise ValueError(f"double free / foreign page id {i}")
             self._allocated.discard(i)
             self._free.append(i)
+
+
+class PrefixCache:
+    """Shared read-only block-table entries: requests whose prompts open
+    with the same token run reuse the pages holding that prefix's K/V.
+
+    WHY THIS IS SOUND: a GPT-2 K/V row at position ``p`` is a pure
+    function of tokens ``0..p`` — identical prefix tokens produce
+    bit-identical K/V. Sharing is restricted to FULL pages strictly
+    inside the prompt (``prompt_len // page_size`` pages), so a sharer's
+    own writes — the rest of its prompt and every generated token — land
+    at positions past the shared region, in its private pages. A sharing
+    prefill does re-write the shared pages, with bit-identical values
+    (same tokens, same positions), so concurrent readers are unaffected
+    and output equality vs a cold prefill is exact (tested).
+
+    LIFETIME is refcounted, because replay/eviction must never free a
+    page a live slot still reads:
+
+    * ``slot refs`` — how many in-flight requests hold the page in their
+      block table. Incremented by :meth:`acquire`, decremented by
+      :meth:`release`.
+    * ``entry refs`` — how many cache entries contain the page. A page is
+      returned to the :class:`PageManager` only when BOTH hit zero
+      (release frees private pages immediately; shared pages persist in
+      the cache — that is the feature — until :meth:`evict` drops their
+      entries under pool pressure, LRU-first, skipping entries any live
+      slot still references).
+
+    Entries are keyed by the raw bytes of the page-aligned token prefix,
+    one entry per full-page depth, so nested prefixes share page ids and
+    a lookup takes the LONGEST cached match.
+    """
+
+    def __init__(self, mgr: PageManager, max_entries: int = 512) -> None:
+        self.mgr = mgr
+        self.page_size = mgr.page_size
+        self.max_entries = max_entries
+        self._entries: "collections.OrderedDict[bytes, List[int]]" = \
+            collections.OrderedDict()
+        self._slot_refs: Dict[int, int] = collections.defaultdict(int)
+        self._entry_refs: Dict[int, int] = collections.defaultdict(int)
+        self.hits = 0
+        self.misses = 0
+        self.pages_reused = 0
+        self.evicted_entries = 0
+
+    # ------------------------------------------------------------- internal
+
+    def _key(self, prompt: np.ndarray, n_pages: int) -> bytes:
+        return np.ascontiguousarray(
+            prompt[:n_pages * self.page_size], np.int32).tobytes()
+
+    def _full_pages(self, prompt_len: int) -> int:
+        return int(prompt_len) // self.page_size
+
+    @property
+    def resident_pages(self) -> int:
+        """Pages held alive by cache entries (shared capital; an upper
+        bound on what :meth:`evict` could hand back under pressure)."""
+        return len(self._entry_refs)
+
+    # -------------------------------------------------------------- acquire
+
+    def acquire(self, prompt: np.ndarray) -> Tuple[List[int], int]:
+        """Longest cached full-page prefix of ``prompt``: slot-refs its
+        pages for the caller and returns ``(page_ids, covered_tokens)``.
+        ``([], 0)`` on a miss — the caller allocates everything fresh."""
+        for j in range(self._full_pages(len(prompt)), 0, -1):
+            pages = self._entries.get(self._key(prompt, j))
+            if pages is not None:
+                self._entries.move_to_end(self._key(prompt, j))
+                for p in pages:
+                    self._slot_refs[p] += 1
+                self.hits += 1
+                self.pages_reused += len(pages)
+                return list(pages), j * self.page_size
+        self.misses += 1
+        return [], 0
+
+    def publish(self, prompt: np.ndarray, pages: np.ndarray,
+                n_acquired: int = 0) -> None:
+        """Register every full-page prefix of an admitted prompt, making
+        its pages shared-capable. ``pages`` is the slot's full reserved
+        page list (shared head + fresh); only the prompt-covering full
+        pages are published — the tail (partial prompt page + generation
+        budget) stays private to the slot.
+
+        INVARIANT: after admission, the slot holds ONE slot-ref on every
+        page of its full-page head — :meth:`acquire` ref'd the first
+        ``n_acquired`` (the cached share), and publish refs the freshly
+        allocated remainder here. Without the publisher's own refs, a
+        sharer could still be reading the pages when the publisher
+        completes, drops the count to zero, and pool-pressure eviction
+        hands them to a new request mid-read (caught by test)."""
+        ids = [int(p) for p in np.asarray(pages).ravel()]
+        k = self._full_pages(len(prompt))
+        for p in ids[n_acquired:k]:
+            self._slot_refs[p] += 1
+        for j in range(1, k + 1):
+            key = self._key(prompt, j)
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            entry = ids[:j]
+            self._entries[key] = entry
+            for p in entry:
+                self._entry_refs[p] += 1
+        while len(self._entries) > self.max_entries:
+            if not self._evict_one():
+                break
+
+    # -------------------------------------------------------------- release
+
+    def release(self, prompt: np.ndarray, pages: np.ndarray) -> np.ndarray:
+        """A slot finished (completion OR replay-abandonment): drop its
+        slot refs on the prefix pages and return the PRIVATE tail pages —
+        the only ones safe to free now. Shared pages stay resident in the
+        cache for the next sharer."""
+        ids = [int(p) for p in np.asarray(pages).ravel()]
+        k = min(self._full_pages(len(prompt)), len(ids))
+        for p in ids[:k]:
+            if self._slot_refs[p] > 0:
+                self._slot_refs[p] -= 1
+            if self._slot_refs[p] == 0:
+                del self._slot_refs[p]
+        return np.asarray(ids[k:], np.int32)
+
+    # --------------------------------------------------------------- evict
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used entry whose pages no live slot
+        references; free pages that leave their last entry. Returns
+        whether anything was evicted."""
+        for key in list(self._entries):
+            entry = self._entries[key]
+            if any(self._slot_refs.get(p, 0) > 0 for p in entry):
+                continue  # a live slot still reads these pages
+            del self._entries[key]
+            self.evicted_entries += 1
+            freed = []
+            for p in entry:
+                self._entry_refs[p] -= 1
+                if self._entry_refs[p] == 0:
+                    del self._entry_refs[p]
+                    freed.append(p)
+            if freed:
+                self.mgr.free(np.asarray(freed, np.int32))
+            return True
+        return False
+
+    def evict_for(self, n_pages: int) -> int:
+        """Free cache-resident pages until the pool can cover ``n_pages``
+        (or nothing evictable remains). Returns pages freed."""
+        freed0 = self.mgr.free_pages
+        while self.mgr.free_pages < n_pages and self._evict_one():
+            pass
+        return self.mgr.free_pages - freed0
+
+    def stats(self) -> Dict[str, int]:
+        return {"prefix_hits": self.hits, "prefix_misses": self.misses,
+                "prefix_pages_reused": self.pages_reused,
+                "prefix_entries": len(self._entries),
+                "prefix_resident_pages": self.resident_pages,
+                "prefix_evicted_entries": self.evicted_entries}

@@ -41,7 +41,7 @@ import numpy as np
 
 from ..utils.perf import EventStats, RecompileMonitor
 from .engine import DecodeEngine
-from .paged_kv import TRASH_PAGE, PageManager
+from .paged_kv import TRASH_PAGE, PageManager, PrefixCache
 
 __all__ = ["Request", "DecodeServer", "one_shot_decode"]
 
@@ -98,7 +98,8 @@ class DecodeServer:
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                  rng: Optional[jax.Array] = None, eos_id: Optional[int] = None,
                  mesh=None, sanitize: bool = False,
-                 dispatch_lag: int = 1) -> None:
+                 dispatch_lag: int = 1,
+                 prefix_cache: bool = False) -> None:
         max_len = max_len or workload.seq_len
         max_prompt_len = max_prompt_len or max(2, max_len // 2)
         pages_per_slot = -(-max_len // page_size)
@@ -123,6 +124,11 @@ class DecodeServer:
             self._recompiles.uninstall()  # failed build must not leak the
             raise                         # process-global 'jax' log handler
         self.mgr = PageManager(max_pages, page_size)
+        # Shared-prefix page reuse (ISSUE 11 satellite): requests whose
+        # prompts open with the same token run share the pages holding
+        # that prefix's K/V (refcounted — see PrefixCache for why replay/
+        # eviction can never free a page a live slot still reads).
+        self.prefix = PrefixCache(self.mgr) if prefix_cache else None
         s = decode_slots
         self.block_tables = np.zeros((s, self.engine.pages_per_slot),
                                      np.int32)  # all TRASH_PAGE
@@ -181,6 +187,10 @@ class DecodeServer:
         self.prefill_steps = 0
         self.tokens_fetched = 0
 
+    def prefix_stats(self) -> dict:
+        """Prefix-cache gauges (empty dict when the cache is off)."""
+        return self.prefix.stats() if self.prefix is not None else {}
+
     # ------------------------------------------------------------ lifecycle
 
     def set_rng(self, key: jax.Array) -> None:
@@ -219,11 +229,45 @@ class DecodeServer:
         st = self.slots[slot]
         if st is None:
             return
-        self.mgr.free(st.pages)
+        if self.prefix is not None:
+            # shared prefix pages stay cache-resident for the next
+            # sharer; only the slot's private tail frees now
+            to_free = self.prefix.release(st.req.prompt, st.pages)
+            if to_free.size:
+                self.mgr.free(to_free)
+        else:
+            self.mgr.free(st.pages)
         self.block_tables[slot, :] = TRASH_PAGE
         self.active[slot] = 0
         self.slots[slot] = None
         self._dirty = True
+
+    def _reserve_pages(self, req: Request) -> Optional[np.ndarray]:
+        """All-or-nothing worst-case page reservation for one admission.
+        With the prefix cache on, the cached full-page prompt prefix is
+        slot-ref'd (not re-allocated) and only the remainder comes from
+        the pool — evicting idle cache entries under pressure before
+        giving up."""
+        total = req.prompt_len + req.g_max
+        n_total = self.mgr.pages_for(total)
+        if self.prefix is None:
+            return self.mgr.alloc(n_total)
+        shared, covered = self.prefix.acquire(req.prompt)
+        need = n_total - len(shared)
+        fresh = (self.mgr.alloc(need) if need > 0
+                 else np.zeros((0,), np.int32))
+        if fresh is None:
+            self.prefix.evict_for(need)
+            fresh = self.mgr.alloc(need)
+        if fresh is None:
+            if shared:  # roll the acquire back: drop the slot refs only
+                self.prefix.release(req.prompt[:covered],
+                                    np.asarray(shared, np.int32))
+            return None
+        pages = np.concatenate(
+            [np.asarray(shared, np.int32), fresh]) if shared else fresh
+        self.prefix.publish(req.prompt, pages, n_acquired=len(shared))
+        return pages
 
     def _admit(self) -> bool:
         """Admit queued requests into free slots, up to one prefill batch.
@@ -237,8 +281,7 @@ class DecodeServer:
         while (self.queue and free
                and len(batch) < self.engine.prefill_batch):
             req = self.queue[0]
-            total = req.prompt_len + req.g_max
-            pages = self.mgr.alloc(self.mgr.pages_for(total))
+            pages = self._reserve_pages(req)
             if pages is None:
                 break  # pool exhausted: wait for completions to free pages
             slot = free.pop(0)

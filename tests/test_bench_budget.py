@@ -116,7 +116,8 @@ def test_bench_only_exact_match_with_optional_glob():
     legs = [(n, None) for n in (
         "diffuseq-base-seq128", "diffuseq-base-seq128-prefetch",
         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
-        "gpt2-serve-decode-b64", "gpt2-base-decode-oneshot-b1")]
+        "gpt2-serve-decode-b64", "gpt2-base-decode-oneshot-b1",
+        "gpt2-serve-fleet-chaos")]
     names = lambda got: [n for n, _ in got]
     assert names(bench.select_legs(legs, "diffuseq-base-seq128")) == \
         ["diffuseq-base-seq128"]
@@ -125,6 +126,10 @@ def test_bench_only_exact_match_with_optional_glob():
          "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos"]
     assert names(bench.select_legs(legs, "*serve-decode*")) == \
         ["gpt2-serve-decode-b64"]
+    # the fleet leg must NOT ride the headline glob (it sits after it so
+    # a timeout degrades to an error row, never a blocked headline)
+    assert names(bench.select_legs(legs, "gpt2-serve-fleet-chaos")) == \
+        ["gpt2-serve-fleet-chaos"]
     assert bench.select_legs(legs, "") == legs
     assert bench.select_legs(legs, "no-such-leg") == []
 
@@ -188,6 +193,54 @@ def test_serve_bench_final_json_carries_rows(serve_bench_run):
     assert (by["gpt2-serve-decode-b64"]["decode_tokens_per_s_per_chip"]
             > 3 * by["gpt2-serve-decode-b1"]
             ["decode_tokens_per_s_per_chip"])
+
+
+# ------------------------------------------------- serving fleet leg
+
+@pytest.fixture(scope="module")
+def fleet_bench_run(tmp_path_factory):
+    """One bench subprocess filtered to the serving-fleet resilience leg
+    (ISSUE 11): 3 replica worker processes, Poisson load, one injected
+    kill_replica mid-request, one checkpoint hot-swap. chaos-marked: it
+    spawns a real multi-process fleet."""
+    tmp = tmp_path_factory.mktemp("fleet_bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "240",
+        "BENCH_LEG_BUDGET_S": "240",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        "BENCH_ONLY": "gpt2-serve-fleet-chaos",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=420)
+    return proc, tmp / "legs.jsonl"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_bench_leg_meets_serving_slos(fleet_bench_run):
+    """The acceptance row: zero dropped admitted requests, >= 1 replay
+    (the injected kill), hot-swap ok, TTFT p50/p95 inside the documented
+    SLO bounds, and the serving ledger accounting every replica-second."""
+    proc, artifact = fleet_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {r["name"]: r for r in
+            (json.loads(line) for line in
+             artifact.read_text().strip().splitlines())}
+    row = rows["gpt2-serve-fleet-chaos"]
+    assert "error" not in row and "skipped" not in row, row
+    assert row["dropped"] == 0
+    assert row["replayed"] >= 1
+    assert row["swap_ok"] is True and row["swap_step"] == 4
+    assert row["ttft_p50_s"] <= row["slo_p50_s"]
+    assert row["ttft_p95_s"] <= row["slo_p95_s"]
+    assert row["accounted_frac"] == pytest.approx(1.0, abs=0.05)
+    assert row["completed"] == row["requests"]
+    assert row["replay_s"] >= 0 and row["fleet_attempts"] >= 4
 
 
 # ------------------------------------------------ compilation-cache wiring

@@ -1,0 +1,794 @@
+"""Serving-fleet resilience tests (ISSUE 11): traffic-process determinism,
+health-gated router placement + replay, the replica file protocol, the
+serving goodput ledger, and fleet end-to-end rings driven by the jax-free
+protocol worker (tests/_fleet_child.py) — kill_replica replay with
+token-identical results, stall_replica + hang-watchdog, zero-downtime
+checkpoint hot-swap, and the corrupt-swap abort."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.chaos import (
+    CHAOS_PLAN_ENV,
+    ChaosInjector,
+    ChaosPlan,
+    aggregate_run,
+    aggregate_serving,
+    goodput,
+    read_attempts,
+)
+from distributed_pipeline_tpu.serving.fleet import (
+    ReplicaPaths,
+    ServingFleet,
+    ServingTracker,
+    WorkerProtocol,
+    find_newest_finalized,
+    read_json_file,
+    write_json_atomic,
+)
+from distributed_pipeline_tpu.serving.router import Router
+from distributed_pipeline_tpu.serving.traffic import TrafficGenerator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ================================================================= traffic
+
+def test_traffic_processes_are_deterministic_and_shaped():
+    for proc in ("poisson", "bursty", "diurnal"):
+        a = TrafficGenerator(proc, 10.0, seed=7).schedule(60)
+        b = TrafficGenerator(proc, 10.0, seed=7).schedule(60)
+        np.testing.assert_array_equal(a, b)
+        assert (np.diff(a) >= 0).all() and a.shape == (60,)
+        c = TrafficGenerator(proc, 10.0, seed=8).schedule(60)
+        assert not np.array_equal(a, c), f"{proc}: seed did nothing"
+    # bursty: groups of burst_size land within a fraction of the gap
+    g = TrafficGenerator("bursty", 10.0, seed=1, burst_every_s=5.0,
+                         burst_size=4).schedule(12)
+    for k in range(3):
+        burst = g[4 * k: 4 * k + 4]
+        assert burst.max() - burst.min() < 1.0
+        assert abs(burst.min() - 5.0 * k) < 1.0
+    # poisson: mean inter-arrival ~ 1/rate (loose: seeded, not flaky)
+    p = TrafficGenerator("poisson", 50.0, seed=3).schedule(400)
+    assert 0.5 / 50.0 < np.diff(p).mean() < 2.0 / 50.0
+    # diurnal: arrivals cluster at the peaks — the busiest half-period
+    # must hold well over half the arrivals
+    d = TrafficGenerator("diurnal", 20.0, seed=5, diurnal_period_s=10.0,
+                         diurnal_floor=0.05).schedule(300)
+    phase = (d % 10.0) / 10.0
+    peak = ((phase > 0.25) & (phase < 0.75)).mean()
+    assert peak > 0.6, f"diurnal peak share {peak}"
+
+
+def test_traffic_requests_deterministic_and_prefix_shared():
+    kw = dict(vocab_size=64, prompt_len=8, max_new_tokens=4,
+              shared_prefix_len=4)
+    r1 = TrafficGenerator("poisson", 5.0, seed=2).requests(6, **kw)
+    r2 = TrafficGenerator("poisson", 5.0, seed=2).requests(6, **kw)
+    for a, b in zip(r1, r2):
+        assert a.t == b.t
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+    # every prompt opens with the same shared prefix
+    head = r1[0].prompt[:4]
+    assert all(np.array_equal(r.prompt[:4], head) for r in r1)
+    assert not all(np.array_equal(r.prompt, r1[0].prompt) for r in r1[1:])
+
+
+def test_traffic_schedule_identical_across_processes(tmp_path):
+    """Same seed => identical arrival schedule in a DIFFERENT interpreter
+    (the determinism contract the bench's reproducibility rides on)."""
+    code = (
+        "from distributed_pipeline_tpu.serving.traffic import "
+        "TrafficGenerator\n"
+        "import json\n"
+        "for p in ('poisson', 'bursty', 'diurnal'):\n"
+        "    s = TrafficGenerator(p, 12.5, seed=11).schedule(40)\n"
+        "    print(json.dumps([p, s.tolist()]))\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    for line in out.stdout.strip().splitlines():
+        proc, sched = json.loads(line)
+        local = TrafficGenerator(proc, 12.5, seed=11).schedule(40)
+        np.testing.assert_array_equal(np.asarray(sched), local)
+
+
+def test_traffic_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown traffic process"):
+        TrafficGenerator("lumpy", 1.0)
+    with pytest.raises(ValueError, match="rate_rps"):
+        TrafficGenerator("poisson", 0.0)
+    with pytest.raises(ValueError, match="burst"):
+        TrafficGenerator("bursty", 1.0, burst_size=0)
+    with pytest.raises(ValueError, match="diurnal_floor"):
+        TrafficGenerator("diurnal", 1.0, diurnal_floor=1.5)
+    with pytest.raises(ValueError, match="prompt_len"):
+        TrafficGenerator("poisson", 1.0).requests(
+            1, vocab_size=8, prompt_len=0, max_new_tokens=1)
+
+
+# ================================================================== router
+
+class FakeReplica:
+    """In-memory stand-in for fleet.ReplicaClient (the router is
+    duck-typed on purpose so placement/replay logic tests need no
+    processes and no filesystem)."""
+
+    def __init__(self, rid, attempt=0):
+        self.rid = rid
+        self._ready = {"attempt": attempt, "params_step": 1}
+        self._alive = True
+        self.beacon_age = 0.0
+        self.inbox = []
+        self.results = []
+
+    def alive(self):
+        return self._alive
+
+    def ready(self):
+        return dict(self._ready) if self._ready is not None else None
+
+    def beacon_age_s(self, now=None):
+        return self.beacon_age
+
+    def submit(self, payload):
+        self.inbox.append(payload)
+
+    def consume_results(self):
+        out, self.results = self.results, []
+        return out
+
+    # test drivers
+    def finish(self, req_id, tokens=(1, 2), ttft=0.1):
+        self.results.append({"id": req_id, "tokens": list(tokens),
+                             "ttft_s": ttft, "params_step": 1})
+        self.inbox = [p for p in self.inbox if p["id"] != req_id]
+
+    def restart(self):
+        self._ready["attempt"] += 1
+        self.inbox = []  # the real worker clears its inbox at startup
+
+
+def _router(tmp_path, n=3, **kw):
+    clients = {i: FakeReplica(i) for i in range(n)}
+    r = Router(clients, str(tmp_path / "journal.jsonl"), **kw)
+    return r, clients
+
+
+def test_router_places_least_loaded_and_health_gates(tmp_path):
+    """The satellite case: one unhealthy replica (stale beacon) receives
+    NO new placements; the rest share the load evenly."""
+    router, clients = _router(tmp_path, n=3, stale_beacon_s=1.0)
+    clients[1].beacon_age = 5.0  # wedged: beacons stopped advancing
+    for _ in range(8):
+        router.submit(np.arange(4), 2)
+    router.poll()
+    assert len(clients[1].inbox) == 0, "stale replica got new work"
+    assert len(clients[0].inbox) == 4 and len(clients[2].inbox) == 4
+    # draining gates placement the same way (hot-swap path)
+    router.set_draining(0)
+    router.submit(np.arange(4), 2)
+    router.poll()
+    assert len(clients[0].inbox) == 4 and len(clients[2].inbox) == 5
+    # nobody healthy: requests queue instead of being lost
+    clients[2].beacon_age = 9.0
+    router.submit(np.arange(4), 2)
+    router.poll()
+    assert router.in_flight == 10 and len(router.queue) == 1
+
+
+def test_router_replays_in_flight_on_epoch_bump(tmp_path):
+    router, clients = _router(tmp_path, n=2)
+    a = router.submit(np.arange(4), 2)
+    b = router.submit(np.arange(4), 2)
+    c = router.submit(np.arange(4), 2)
+    router.poll()
+    victim = a.replica
+    sibling = 1 - victim
+    mine = [r for r in (a, b, c) if r.replica == victim]
+    done_req = mine[0]
+    # one request finished JUST before the kill: its outbox result must
+    # win over the replay (consume-then-requeue ordering)
+    clients[victim].finish(done_req.id)
+    clients[victim].restart()
+    router.poll()
+    assert done_req.state == "done" and done_req.replays == 0
+    survivors = [r for r in mine if r is not done_req]
+    placed = {p["id"]: p
+              for c in (clients[victim], clients[sibling])
+              for p in c.inbox}
+    for r in survivors:
+        # re-placed with the replay booked (the restarted victim is a
+        # legal target again — its inbox was cleared at startup, so
+        # nothing double-serves); the resubmitted payload carries the
+        # bumped replay count
+        assert r.state == "assigned" and r.replays == 1
+        assert placed[r.id]["replays"] == 1
+    assert router.replayed == len(survivors)
+    events = [json.loads(l) for l in
+              open(str(tmp_path / "journal.jsonl"))]
+    replays = [e for e in events if e["ev"] == "replay"]
+    assert {e["id"] for e in replays} == {r.id for r in survivors}
+    assert all(e["wasted_s"] >= 0 for e in replays)
+
+
+def test_router_marks_dead_supervisor_down_and_replays(tmp_path):
+    router, clients = _router(tmp_path, n=2)
+    a = router.submit(np.arange(4), 2)
+    router.poll()
+    rid = a.replica
+    clients[rid]._alive = False  # supervisor exited: no restarts coming
+    router.poll()
+    assert router.down(rid)
+    assert a.replica == 1 - rid and a.replays == 1
+    # a down replica never comes back into placement
+    for _ in range(3):
+        router.submit(np.arange(4), 2)
+        router.poll()
+    assert len(clients[rid].inbox) <= 1  # only the pre-death assignment
+
+
+def test_router_recovers_pending_state_from_journal(tmp_path):
+    router, clients = _router(tmp_path, n=2)
+    a = router.submit(np.asarray([5, 6, 7]), 3)
+    b = router.submit(np.asarray([8, 9]), 2)
+    router.poll()
+    clients[a.replica].finish(a.id, tokens=(42,))
+    router.poll()
+    assert a.state == "done" and b.state == "assigned"
+    # router process dies; a new one rebuilds from the journal alone
+    clients2 = {i: FakeReplica(i) for i in range(2)}
+    r2 = Router.recover(clients2, str(tmp_path / "journal.jsonl"))
+    ra, rb = r2.records[a.id], r2.records[b.id]
+    assert ra.state == "done"
+    assert rb.state == "pending"
+    np.testing.assert_array_equal(rb.prompt, [8, 9])
+    r2.poll()
+    assert rb.state == "assigned"  # re-placed, not lost
+
+
+# ======================================================== protocol + fleet
+
+def test_worker_protocol_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("DPT_ATTEMPT", "2")
+    monkeypatch.delenv("DPT_RUN_DIR_FILE", raising=False)
+    paths = ReplicaPaths(str(tmp_path), 0).ensure()
+    proto = WorkerProtocol(paths, 0)
+    assert proto.attempt == 2
+    # stale inbox entry from a previous attempt is cleared at startup
+    write_json_atomic(paths.req_path(9), {"id": 9, "prompt": [1]})
+    write_json_atomic(paths.current_path, {"dir": "x", "step": 4})
+    pin = proto.startup()
+    assert pin["step"] == 4 and proto.poll_inbox() == []
+    # request in, result out
+    write_json_atomic(paths.req_path(1), {"id": 1, "prompt": [1, 2],
+                                          "max_new_tokens": 2})
+    got = proto.poll_inbox()
+    assert [g["id"] for g in got] == [1]
+    proto.consume(1)
+    assert proto.poll_inbox() == []
+    proto.write_result({"id": 1, "tokens": [7, 8], "ttft_s": 0.1})
+    res = read_json_file(paths.result_path(1))
+    assert res["tokens"] == [7, 8] and res["attempt"] == 2
+    # swap command / ack cycle, idempotent per id
+    write_json_atomic(paths.swap_path, {"id": 5, "step": 3, "target": "t"})
+    cmd = proto.pending_swap()
+    assert cmd["id"] == 5
+    proto.ack_swap(5, True, 3)
+    assert proto.pending_swap() is None  # same id: already handled
+    ack = read_json_file(paths.swap_ack_path)
+    assert ack["ok"] and ack["params_step"] == 3
+    # beacon carries the serving snapshot with the accounting identity
+    proto.tracker.t_start = time.time() - 5.0  # a 5s-old attempt
+    proto.tracker.book("drain_s", 0.5)
+    proto.write_beacon(7)
+    beacon = read_json_file(goodput.beacon_path(paths.root, 0))
+    assert beacon["step"] == 7 and beacon["attempt"] == 2
+    snap = beacon["serving"]
+    assert snap["wall_s"] == pytest.approx(
+        snap["serving_s"] + snap["drain_s"] + snap["swap_s"], abs=1e-5)
+    proto.write_sidecar({"completed": 3})
+    side = goodput.read_serving_records(paths.root)
+    assert side[2]["completed"] == 3
+
+
+def test_serving_tracker_identity():
+    tr = ServingTracker(t_start=time.time() - 2.0)
+    tr.book("swap_s", 0.25)
+    with tr.timed("drain_s"):
+        time.sleep(0.01)
+    s = tr.snapshot()
+    # each field rounds to 6 decimals independently: identity to ~1e-5
+    assert s["wall_s"] == pytest.approx(
+        s["serving_s"] + s["drain_s"] + s["swap_s"], abs=1e-5)
+    assert s["swap_s"] == pytest.approx(0.25)
+    assert s["drain_s"] >= 0.01
+
+
+def _fake_ckpt(base, step, salt):
+    d = os.path.join(str(base), f"model_{step:06d}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "_CHECKPOINT_METADATA"), "w") as f:
+        f.write("{}")
+    with open(os.path.join(d, "params.json"), "w") as f:
+        json.dump({"step": step, "salt": salt}, f)
+    return d
+
+
+def test_find_newest_finalized(tmp_path):
+    assert find_newest_finalized(str(tmp_path)) is None
+    _fake_ckpt(tmp_path, 2, 0)
+    p5 = _fake_ckpt(tmp_path, 5, 0)
+    # an unfinalized newer dir (no commit marker) is skipped
+    os.makedirs(str(tmp_path / "model_000009"))
+    assert find_newest_finalized(str(tmp_path)) == p5
+
+
+def test_fleet_wires_supervision_knobs_into_rings(tmp_path, monkeypatch):
+    """ServingFleet rides the REAL launcher supervision path — the
+    kw-tolerant shared ring stub records what each replica's ring was
+    launched with (per-replica env, watchdog, budget, single worker)."""
+    from distributed_pipeline_tpu.parallel import launcher
+
+    from tests._fake_ring import make_fake_ring
+
+    fake = make_fake_ring(codes=(0,))
+    monkeypatch.setattr(launcher, "_run_worker_ring", fake)
+    fleet = ServingFleet(str(tmp_path / "fleet"), 3, "mod",
+                         ["--checkpoint_dir", "x"],
+                         hang_timeout_s=2.5, max_restarts=4,
+                         restart_backoff_s=0.1)
+    fleet.start()
+    deadline = time.time() + 10
+    while any(fleet.alive(i) for i in range(3)) and time.time() < deadline:
+        time.sleep(0.01)
+    assert [fleet.rc(i) for i in range(3)] == [0, 0, 0]
+    assert len(fake.calls) == 3
+    replicas = set()
+    for call in fake.calls:
+        assert call["nprocs"] == 1
+        assert call["hang_timeout_s"] == 2.5
+        env = call["extra_env"]
+        replicas.add(env["DPT_REPLICA"])
+        rid = int(env["DPT_REPLICA"])
+        argv = call["cmd_base"]
+        assert argv[argv.index("--fleet_worker_dir") + 1] == \
+            fleet.paths[rid].root
+        assert argv[argv.index("--replica_id") + 1] == str(rid)
+    assert replicas == {"0", "1", "2"}
+
+
+# ================================================== serving goodput ledger
+
+def test_aggregate_serving_identity_and_degrade(tmp_path):
+    d = str(tmp_path)
+    for rid in range(2):
+        rd = goodput.replica_dir(d, rid)
+        os.makedirs(rd)
+        # attempt 0: killed — snapshot from the post-mortem beacon
+        # harvest covers 9 of its 10s; the 1s tail is lost
+        goodput.append_attempt(rd, {
+            "attempt": 0, "rc": -9, "t_spawn": 100.0, "t_exit": 110.0,
+            "duration_s": 10.0, "downtime_s": 0.0,
+            "serving": {"wall_s": 9.0, "serving_s": 8.0,
+                        "drain_s": 0.5, "swap_s": 0.5}})
+        # attempt 1: clean exit with a sidecar
+        goodput.append_attempt(rd, {
+            "attempt": 1, "rc": 0, "t_spawn": 111.0, "t_exit": 116.0,
+            "duration_s": 5.0, "downtime_s": 1.0})
+        with open(goodput.serving_record_path(rd, 1), "w") as f:
+            json.dump({"attempt": 1, "wall_s": 5.0, "serving_s": 4.0,
+                       "drain_s": 0.5, "swap_s": 0.5}, f)
+    with open(goodput.serving_journal_path(d), "w") as f:
+        f.write(json.dumps({"ev": "replay", "id": 1, "wasted_s": 2.0})
+                + "\n")
+        f.write('{"ev": "replay", "id": 2, "wasted_')  # torn tail
+    agg = aggregate_serving(d)
+    # per replica: 15s attempts + 1s downtime = 16; fleet wall 32
+    assert agg["wall_s"] == pytest.approx(32.0)
+    assert agg["accounted_frac"] == pytest.approx(1.0)
+    assert agg["replay_s"] == pytest.approx(2.0)
+    assert agg["serving_s"] == pytest.approx(2 * (8.0 + 4.0) - 2.0)
+    assert agg["drain_s"] == pytest.approx(2.0)
+    assert agg["swap_s"] == pytest.approx(2.0)
+    assert agg["lost_s"] == pytest.approx(2.0)
+    assert agg["downtime_s"] == pytest.approx(2.0)
+    assert agg["replicas"] == 2 and agg["attempts"] == 4
+
+
+def test_aggregate_serving_degrades_on_garbage(tmp_path):
+    d = str(tmp_path)
+    rd = goodput.replica_dir(d, 0)
+    os.makedirs(rd)
+    # null duration + garbled snapshot: attempt folds to lost
+    goodput.append_attempt(rd, {"attempt": 0, "rc": 1, "t_spawn": 10.0,
+                                "t_exit": 14.0, "duration_s": None,
+                                "downtime_s": 0.0, "serving": "garbage"})
+    agg = aggregate_serving(d)
+    assert agg["lost_s"] == pytest.approx(4.0)
+    assert agg["accounted_frac"] == pytest.approx(1.0)
+    assert aggregate_serving(str(tmp_path / "empty"))["attempts"] == 0
+
+
+def test_aggregate_run_mixed_dir_degrades_serving_attempts(tmp_path):
+    """The satellite fix: a run dir holding SERVING artifacts (a replica
+    dir, or a mixed train+serve dir) folds without raising — serving
+    attempts degrade to lost, accounted_frac stays 1.0."""
+    d = str(tmp_path)
+    goodput.append_attempt(d, {
+        "attempt": 0, "rc": -9, "t_spawn": 0.0, "t_exit": 8.0,
+        "duration_s": 8.0, "downtime_s": 0.0,
+        "serving": {"wall_s": 7.0, "serving_s": 7.0,
+                    "drain_s": 0.0, "swap_s": 0.0}})
+    with open(goodput.serving_record_path(d, 1), "w") as f:
+        json.dump({"attempt": 1, "wall_s": 3.0, "serving_s": 3.0,
+                   "drain_s": 0.0, "swap_s": 0.0}, f)
+    goodput.append_attempt(d, {"attempt": 1, "rc": 0, "t_spawn": 9.0,
+                               "t_exit": 12.0, "duration_s": 3.0,
+                               "downtime_s": 1.0})
+    agg = aggregate_run(d)
+    assert agg["serving_attempts"] == 2
+    assert agg["lost_s"] == pytest.approx(11.0)  # both walls -> lost
+    assert agg["accounted_frac"] == pytest.approx(1.0)
+    sources = [a["goodput_source"] for a in agg["per_attempt"]]
+    assert sources == ["serving", "serving"]
+
+
+# ====================================================== chaos serving faults
+
+def test_plan_parses_serving_faults_and_rejects_garbage():
+    plan = ChaosPlan.parse(json.dumps({"faults": [
+        {"kind": "kill_replica", "step": 2, "rank": 1, "sig": "SIGKILL"},
+        {"kind": "stall_replica", "step": 1, "rank": 0, "seconds": 3.0},
+        {"kind": "corrupt_swap_checkpoint", "step": 0},
+    ]}))
+    assert "kill_replica@step2/rank1 SIGKILL" in plan.describe()
+    assert "stall_replica@step1/rank0 3.0s" in plan.describe()
+    with pytest.raises(ValueError, match="seconds > 0"):
+        ChaosPlan.parse('{"faults": [{"kind": "stall_replica", '
+                        '"step": 1, "seconds": 0}]}')
+    with pytest.raises(ValueError, match="unknown chaos fault kind"):
+        ChaosPlan.parse('{"faults": [{"kind": "kill_fleet", "step": 1}]}')
+
+
+def test_injector_serve_tick_threshold_and_marker(tmp_path, monkeypatch):
+    plan = ChaosPlan.parse('{"faults": [{"kind": "kill_replica", '
+                           '"step": 3, "rank": 1}]}')
+    inj = ChaosInjector(plan, rank=1, run_dir=str(tmp_path))
+    kills = []
+    monkeypatch.setattr(inj, "_fire_kill", lambda f: kills.append(f.kind))
+    inj.on_serve_tick(admitted=5, in_flight=0)   # idle: never fires
+    assert kills == []
+    inj.on_serve_tick(admitted=2, in_flight=1)   # below threshold
+    assert kills == []
+    inj.on_serve_tick(admitted=4, in_flight=1)   # >= step and mid-request
+    assert kills == ["kill_replica"]
+    inj.on_serve_tick(admitted=9, in_flight=2)   # marker: fires once
+    assert kills == ["kill_replica"]
+    # a different rank's injector never fires this fault
+    inj0 = ChaosInjector(plan, rank=0, run_dir=str(tmp_path / "other"))
+    monkeypatch.setattr(inj0, "_fire_kill",
+                        lambda f: kills.append("rank0"))
+    inj0.on_serve_tick(admitted=9, in_flight=1)
+    assert kills == ["kill_replica"]
+
+
+def test_injector_on_swap_corrupts_target_once(tmp_path):
+    target = _fake_ckpt(tmp_path, 2, salt=7)
+    plan = ChaosPlan.parse(
+        '{"faults": [{"kind": "corrupt_swap_checkpoint", "step": 0}]}')
+    inj = ChaosInjector(plan, rank=0, run_dir=str(tmp_path))
+    assert inj.on_swap(target) is True
+    with pytest.raises(ValueError):
+        json.load(open(os.path.join(target, "params.json")))
+    # commit marker intact: the dir still LOOKS finalized
+    assert os.path.exists(os.path.join(target, "_CHECKPOINT_METADATA"))
+    assert inj.on_swap(target) is False  # marker: once per run
+
+
+# ======================================================= fleet e2e (fake)
+
+def _expected_tokens(prompt, n, salt):
+    return [(31 * sum(int(t) for t in prompt) + 1000 * salt + k) % 50021
+            for k in range(n)]
+
+
+def _start_fleet(tmp_path, n, ckpt_dir, *, token_interval=0.01,
+                 hang_timeout_s=0.0, max_restarts=3, stale_beacon_s=10.0):
+    fleet_dir = str(tmp_path / "fleet")
+    fleet = ServingFleet(
+        fleet_dir, n, "tests._fleet_child",
+        ["--checkpoint_dir", str(ckpt_dir), "--step", "1",
+         "--token_interval_s", str(token_interval)],
+        hang_timeout_s=hang_timeout_s, max_restarts=max_restarts,
+        restart_backoff_s=0.1, restart_backoff_max_s=0.5,
+        monitor_interval=0.02)
+    fleet.start()
+    router = Router(fleet.clients(),
+                    goodput.serving_journal_path(fleet_dir),
+                    stale_beacon_s=stale_beacon_s)
+    deadline = time.time() + 20
+    while len(fleet.ready_replicas()) < n and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(fleet.ready_replicas()) == n, "fleet never came up"
+    return fleet, router
+
+
+def _drive(router, fleet, timeout_s=45.0, tick=None):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        router.poll()
+        if tick is not None:
+            tick()
+        if router.all_done() and not fleet.swap_active:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"fleet did not finish: {router.completed}/{router.submitted} "
+        f"done, swap_active={fleet.swap_active}")
+
+
+@pytest.mark.chaos
+def test_fleet_kill_replica_replays_token_identical(tmp_path, monkeypatch):
+    """The headline chaos e2e: killing a replica mid-request completes
+    every admitted request, replays are token-identical (deterministic
+    decode, same params version), and the serving ledger accounts every
+    replica-second."""
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=3)
+    plan = {"faults": [{"kind": "kill_replica", "step": 1, "rank": 1,
+                        "sig": "SIGKILL"}]}
+    monkeypatch.setenv(CHAOS_PLAN_ENV, json.dumps(plan))
+    fleet, router = _start_fleet(tmp_path, 3, ckpt)
+    try:
+        prompts = [np.arange(i + 1, i + 5, dtype=np.int32)
+                   for i in range(9)]
+        for p in prompts:
+            router.submit(p, 12)
+        _drive(router, fleet)
+    finally:
+        fleet.stop()
+    recs = sorted(router.records.values(), key=lambda r: r.id)
+    assert router.submitted == 9 and router.completed == 9  # zero dropped
+    assert router.replayed >= 1, "the kill never forced a replay"
+    for rec, prompt in zip(recs, prompts):
+        assert rec.tokens == _expected_tokens(prompt, 12, salt=3), (
+            f"request {rec.id} (replays={rec.replays}) tokens diverged")
+    assert any(r.replays > 0 for r in recs)
+    # the victim's attempt record carries the post-mortem serving
+    # snapshot (launcher harvest), and the ledger accounts to 1.0
+    victim_recs = read_attempts(goodput.replica_dir(
+        str(tmp_path / "fleet"), 1))
+    assert len(victim_recs) >= 2  # killed + respawned
+    assert any(isinstance(r.get("serving"), dict) for r in victim_recs)
+    agg = aggregate_serving(str(tmp_path / "fleet"))
+    assert agg["accounted_frac"] == pytest.approx(1.0, abs=0.05)
+    assert agg["replay_s"] > 0
+    events = goodput.read_journal(
+        goodput.serving_journal_path(str(tmp_path / "fleet")))
+    assert any(e["ev"] == "replay" for e in events)
+
+
+@pytest.mark.chaos
+def test_fleet_stall_replica_watchdog_kills_and_replays(tmp_path,
+                                                        monkeypatch):
+    """A WEDGED replica (alive, beacons frozen) is killed by the
+    per-replica hang watchdog; its in-flight requests replay and the
+    attempt record books the hang."""
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=5)
+    plan = {"faults": [{"kind": "stall_replica", "step": 1, "rank": 0,
+                        "seconds": 60.0}]}
+    monkeypatch.setenv(CHAOS_PLAN_ENV, json.dumps(plan))
+    fleet, router = _start_fleet(tmp_path, 2, ckpt, hang_timeout_s=1.0,
+                                 stale_beacon_s=0.5)
+    try:
+        prompts = [np.arange(i + 1, i + 4, dtype=np.int32)
+                   for i in range(6)]
+        for p in prompts:
+            router.submit(p, 10)
+        _drive(router, fleet, timeout_s=60.0)
+    finally:
+        fleet.stop()
+    assert router.completed == 6
+    assert router.replayed >= 1
+    for rec, prompt in zip(sorted(router.records.values(),
+                                  key=lambda r: r.id), prompts):
+        assert rec.tokens == _expected_tokens(prompt, 10, salt=5)
+    recs = read_attempts(goodput.replica_dir(str(tmp_path / "fleet"), 0))
+    hung = [r for r in recs if r.get("hung")]
+    assert hung, "watchdog never booked the hang"
+    assert hung[0]["hang_s"] >= 1.0
+
+
+@pytest.mark.chaos
+def test_fleet_hot_swap_zero_downtime(tmp_path):
+    """Rolling swap 1 -> 2: zero dropped requests, one replica at a time
+    (windows serialized => >= N-1 serving at every instant), and
+    post-swap requests visibly decode with the new params."""
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=3)
+    _fake_ckpt(ckpt, 2, salt=9)
+    fleet, router = _start_fleet(tmp_path, 3, ckpt)
+    swap_report = {}
+    try:
+        for i in range(6):
+            router.submit(np.arange(i + 1, i + 4, dtype=np.int32), 8)
+        # let some traffic complete, then roll — traffic keeps flowing
+        deadline = time.time() + 30
+        while router.completed < 2 and time.time() < deadline:
+            router.poll()
+            time.sleep(0.02)
+        arm = fleet.begin_hot_swap(str(ckpt), step=2,
+                                   drain_timeout_s=20, swap_timeout_s=20)
+        assert arm["step"] == 2 and len(arm["order"]) == 3
+        extra = []
+
+        def trickle():
+            if len(extra) < 6:
+                extra.append(router.submit(
+                    np.arange(len(extra) + 10,
+                              len(extra) + 14, dtype=np.int32), 6))
+            rep = fleet.step_swap(router)
+            if rep is not None:
+                swap_report.update(rep)
+
+        _drive(router, fleet, timeout_s=60.0, tick=trickle)
+    finally:
+        fleet.stop()
+    assert swap_report.get("ok") is True, swap_report
+    assert sorted(swap_report["swapped"]) == [0, 1, 2]
+    assert router.completed == router.submitted  # zero dropped
+    # one-replica-at-a-time: the swap windows must not overlap
+    windows = sorted(v for v in swap_report["windows"].values())
+    for (s0, e0), (s1, e1) in zip(windows, windows[1:]):
+        assert e0 is not None and e0 <= s1 + 1e-6, windows
+    # every replica restarted-from-here would load step 2 (the pin)
+    for rid in range(3):
+        pin = read_json_file(fleet.paths[rid].current_path)
+        assert pin and pin["step"] == 2
+    # late requests decoded under the NEW params version
+    last = max(router.records.values(), key=lambda r: r.id)
+    assert last.params_step == 2
+    assert last.tokens == _expected_tokens(last.prompt, 6, salt=9)
+
+
+@pytest.mark.chaos
+def test_fleet_corrupt_swap_aborts_with_old_weights(tmp_path, monkeypatch):
+    """corrupt_swap_checkpoint: the canary refuses the garbled target,
+    the swap aborts before ANY replica moved, and the fleet keeps serving
+    the old weights — no partial-fleet version skew."""
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=3)
+    _fake_ckpt(ckpt, 2, salt=9)
+    plan = {"faults": [{"kind": "corrupt_swap_checkpoint", "step": 0}]}
+    monkeypatch.setenv(CHAOS_PLAN_ENV, json.dumps(plan))
+    fleet, router = _start_fleet(tmp_path, 2, ckpt)
+    fleet_dir = str(tmp_path / "fleet")
+    inj = ChaosInjector(ChaosPlan.parse(json.dumps(plan)), rank=0,
+                        run_dir=fleet_dir)
+    swap_report = {}
+    try:
+        for i in range(4):
+            router.submit(np.arange(i + 1, i + 4, dtype=np.int32), 6)
+        arm = fleet.begin_hot_swap(str(ckpt), step=2, injector=inj,
+                                   drain_timeout_s=20, swap_timeout_s=20)
+        assert arm["injected"] is True
+
+        def tick():
+            rep = fleet.step_swap(router)
+            if rep is not None:
+                swap_report.update(rep)
+
+        _drive(router, fleet, timeout_s=60.0, tick=tick)
+        # the fleet must still SERVE after the abort, on old weights
+        post = router.submit(np.asarray([9, 9, 9], np.int32), 5)
+        _drive(router, fleet, timeout_s=30.0)
+    finally:
+        fleet.stop()
+    assert swap_report.get("ok") is False, swap_report
+    assert swap_report["swapped"] == []  # canary aborted before any move
+    assert "refused" in swap_report["error"]
+    assert router.completed == router.submitted
+    assert post.params_step == 1
+    assert post.tokens == _expected_tokens(post.prompt, 5, salt=3)
+    # no restart pin was written: a respawned replica stays on step 1
+    for rid in range(2):
+        assert read_json_file(fleet.paths[rid].current_path) is None
+        ready = read_json_file(fleet.paths[rid].ready_path)
+        assert ready["params_step"] == 1
+
+
+# ============================================== settings + real-model e2e
+
+def test_serve_settings_fleet_fields_roundtrip():
+    from distributed_pipeline_tpu.config.serve import ServeSettings
+
+    s = ServeSettings.from_argv(
+        ["--checkpoint_path", "/tmp/run", "--replicas", "3",
+         "--traffic", "bursty", "--rate_rps", "4.5", "--burst_size", "3",
+         "--prefix_cache", "true", "--swap_after_requests", "7",
+         "--hang_timeout_s", "2.0", "--shared_prefix_len", "6"])
+    assert (s.replicas, s.traffic, s.burst_size) == (3, "bursty", 3)
+    assert s.prefix_cache is True and s.swap_after_requests == 7
+    assert s.rate_rps == 4.5 and s.shared_prefix_len == 6
+    s2 = ServeSettings.model_validate(json.loads(s.to_json()))
+    assert s2 == s
+    with pytest.raises(SystemExit):
+        ServeSettings.from_argv(["--checkpoint_path", "x",
+                                 "--traffic", "lumpy"])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_real_model_fleet_kill_and_hot_swap_e2e(tmp_path):
+    """Full-stack ring: run/serve.py --replicas 2 over a REAL tiny-GPT-2
+    run dir (jax workers), Poisson traffic, one kill_replica mid-request
+    and one checkpoint hot-swap — zero dropped, replay happened, swap
+    ok, serving ledger accounts to 1.0."""
+    import jax
+
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.parallel import make_mesh
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    vocab, seq = 32, 16
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=vocab, seq_len=seq,
+        hidden_size=32, num_layers=2, num_heads=2, dtype="float32")
+    data = load_data_from_args("train", batch_size=8,
+                               dataset="synthetic-lm", seq_len=seq,
+                               vocab_size=vocab, seed=0)
+    run_dir = tmp_path / "run"
+    loop = TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                     ema_rate="0.99", learning_steps=0,
+                     log_interval=10 ** 9, save_interval=10 ** 9,
+                     mesh=make_mesh(dp=8), checkpoint_dir=str(run_dir))
+    for _ in range(2):
+        loop.run_step(next(loop.data))
+    loop.save()                      # model_000002 — the serving version
+    for _ in range(2):
+        loop.run_step(next(loop.data))
+    loop.save()                      # model_000004 — the swap target
+    loop.wait_for_saves()
+    with open(run_dir / "training_args.json", "w") as f:
+        json.dump(dict(model_family="gpt2", model_size="base",
+                       vocab_size=vocab, seq_len=seq, hidden_size=32,
+                       num_layers=2, num_heads=2, dtype="float32",
+                       dataset="synthetic-lm", seed=0), f)
+
+    plan = {"faults": [{"kind": "kill_replica", "step": 2, "rank": 1,
+                        "sig": "SIGKILL"}]}
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "DPT_CHAOS_PLAN": json.dumps(plan)})
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_pipeline_tpu.run.serve",
+         "--checkpoint_path", str(run_dir), "--step", "2",
+         "--replicas", "2", "--fleet_dir", str(tmp_path / "fleet"),
+         "--decode_slots", "2", "--page_size", "4",
+         "--max_prompt_len", "8", "--max_new_tokens", "6",
+         "--traffic", "poisson", "--rate_rps", "4",
+         "--synthetic_requests", "10", "--synthetic_prompt_len", "6",
+         "--swap_after_requests", "3", "--swap_step", "4",
+         "--hang_timeout_s", "30", "--fleet_deadline_s", "240"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["requests"] == 10 and res["dropped"] == 0, res
+    assert res["replayed"] >= 1, res
+    assert res["swap"] and res["swap"]["ok"] is True, res["swap"]
+    assert res["swap"]["step"] == 4
+    assert res["serving_goodput"]["accounted_frac"] == pytest.approx(
+        1.0, abs=0.05)
+    assert res["ttft_p95_s"] is not None and res["ttft_p95_s"] > 0
+    assert res["decode_tokens"] == 10 * 6

@@ -35,6 +35,9 @@ GATED_PATHS = [
     # the elastic/watchdog tests drive TrainLoop outer loops across
     # topology changes (GL007) and assert on restored sharded state
     os.path.join(ROOT, "tests", "test_elastic.py"),
+    # the serving-fleet tests drive router/fleet host loops and the
+    # replica protocol (GL007 territory once real decode rides them)
+    os.path.join(ROOT, "tests", "test_fleet.py"),
 ]
 
 

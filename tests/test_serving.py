@@ -239,6 +239,120 @@ def test_late_arrival_preempts_nothing(wl_and_params):
     assert srv.free_slots == 2 and srv.mgr.free_pages == srv.mgr.capacity
 
 
+def test_prefix_cache_is_bit_identical_to_cold_prefill(wl_and_params):
+    """ISSUE 11 satellite: a warm prefix-cache hit — the prompt's
+    full-page K/V pages reused from an earlier request — produces
+    token-for-token the same greedy output as a cold prefill, and the
+    reused pages actually came out of the cache (hit + reuse gauges)."""
+    wl, params = wl_and_params
+    prompt = np.random.default_rng(0).integers(4, VOCAB, (8,)).astype(
+        np.int32)
+
+    cold = make_server(wl, params)
+    ref = cold.submit(prompt, max_new_tokens=6)
+    cold.drain()
+
+    warm = make_server(wl, params, prefix_cache=True)
+    first = warm.submit(prompt, max_new_tokens=6)
+    warm.drain()
+    second = warm.submit(prompt, max_new_tokens=6)  # hits the cache
+    warm.drain()
+    assert first.tokens == ref.tokens
+    assert second.tokens == ref.tokens
+    st = warm.prefix_stats()
+    assert st["prefix_hits"] >= 1 and st["prefix_pages_reused"] >= 2
+    # pool accounting: cache-resident pages are held, not leaked — the
+    # free count plus residency is exactly the capacity
+    assert warm.mgr.free_pages + st["prefix_resident_pages"] == \
+        warm.mgr.capacity
+    # a DIVERGENT prompt sharing only the first page reuses exactly that
+    # page and still decodes like its own cold run
+    div = prompt.copy()
+    div[5] = (div[5] + 1) % VOCAB
+    cold2 = make_server(wl, params)
+    ref2 = cold2.submit(div, max_new_tokens=6)
+    cold2.drain()
+    got2 = warm.submit(div, max_new_tokens=6)
+    warm.drain()
+    assert got2.tokens == ref2.tokens
+
+
+def test_prefix_cache_refcount_blocks_early_free(wl_and_params):
+    """Replay/eviction can never free a shared page a live slot still
+    reads: A and B share a prefix, A completes first (the shared pages
+    must survive A's release), and pool-pressure eviction skips entries
+    whose pages are slot-ref'd — B's output stays exact throughout."""
+    wl, params = wl_and_params
+    prompt = np.random.default_rng(1).integers(4, VOCAB, (8,)).astype(
+        np.int32)
+    cold = make_server(wl, params)
+    ref = cold.submit(prompt, max_new_tokens=6)
+    cold.drain()
+
+    srv = make_server(wl, params, prefix_cache=True)
+    a = srv.submit(prompt, max_new_tokens=2)   # finishes first, releases
+    b = srv.submit(prompt, max_new_tokens=6)   # still reading the pages
+    srv.drain()
+    assert a.tokens == ref.tokens[:2]
+    assert b.tokens == ref.tokens
+
+    # the killer scenario: the PUBLISHER (a) completes while the sharer
+    # (b) still decodes, and a third prompt's admission puts the pool
+    # under eviction pressure mid-flight — the shared head pages must
+    # survive (b holds slot refs) and c must WAIT, not steal them
+    other = np.asarray([9, 13, 17, 21, 25, 29, 5, 7], np.int32)
+    cold3 = make_server(wl, params)
+    ref3 = cold3.submit(other, max_new_tokens=6)
+    cold3.drain()
+    # pool sized so c's 4 pages only fit once the 2 cached head pages
+    # are evicted: capacity 5 = a(3) + b's fresh(2) at admission, and
+    # 3 free after both complete — eviction must yield the last 2
+    tight = make_server(wl, params, decode_slots=2, max_pages=6,
+                        prefix_cache=True)
+    a2 = tight.submit(prompt, max_new_tokens=2)   # publisher, done early
+    b2 = tight.submit(prompt, max_new_tokens=6)   # sharer, long-lived
+    tight.step()                                  # both admitted
+    c2 = tight.submit(other, max_new_tokens=6)    # needs eviction to fit
+    tight.drain()
+    assert a2.tokens == ref.tokens[:2]
+    assert b2.tokens == ref.tokens, \
+        "sharer's pages were stolen mid-flight"
+    assert c2.tokens == ref3.tokens
+    assert tight.prefix_stats()["prefix_evicted_entries"] >= 1
+    # ...and with the pool at rest, nothing leaked
+    st = tight.prefix_stats()
+    assert tight.mgr.free_pages + st["prefix_resident_pages"] == \
+        tight.mgr.capacity
+
+
+def test_prefix_cache_unit_refcounts():
+    """PrefixCache bookkeeping in isolation: acquire refs, release frees
+    only the private tail, eviction skips slot-ref'd entries and frees a
+    page only when it leaves its last entry."""
+    from distributed_pipeline_tpu.serving import PageManager, PrefixCache
+
+    mgr = PageManager(num_pages=9, page_size=4)
+    cache = PrefixCache(mgr)
+    prompt = np.arange(10, dtype=np.int32)    # 2 full pages + tail
+    assert cache.acquire(prompt) == ([], 0)   # miss
+    pages = mgr.alloc(4)                      # 10 prompt + gen -> 4 pages
+    cache.publish(prompt, pages)
+    shared, covered = cache.acquire(prompt)
+    assert covered == 8 and shared == [int(p) for p in pages[:2]]
+    # release with one acquire outstanding: only the tail frees
+    tail = cache.release(prompt, pages)
+    assert tail.tolist() == [int(p) for p in pages[2:]]
+    mgr.free(tail)
+    # still slot-ref'd from the second acquire: nothing evictable
+    assert cache.evict_for(mgr.capacity + 1) == 0
+    cache.release(prompt, np.asarray(shared, np.int32))
+    # now idle: eviction frees both shared pages (both entries drop)
+    freed = cache.evict_for(mgr.free_pages + 2)
+    assert freed == 2
+    assert mgr.free_pages == mgr.capacity
+    assert cache.stats()["prefix_entries"] == 0
+
+
 def test_eos_finishes_early_and_frees_slot(wl_and_params):
     """EOS completion: learn the greedy continuation once, then re-serve
     with eos_id set to its second token — the request must stop there
