@@ -1004,6 +1004,156 @@ def main() -> None:
             "opt_state_bytes_per_replica", "peak_live_bytes")})
         return row
 
+    def measure_trace_ab(name: str, *, family: str, size: str,
+                         seq_len: int, batch: int, microbatch: int = 0,
+                         window_steps: int = 4, rounds: int = 6):
+        """Trace-overhead guard (ISSUE 12): paired interleaved A/B at the
+        headline settings between span tracing ON (obs/: one step span +
+        flushed JSONL append per step, booked into a real run dir) and
+        OFF (the NULL-tracer zero-cost path). Same ABBA protocol as
+        measure_prefetch_ab — both loops stay alive, short timed windows
+        interleave with alternating order, the delta comes from the
+        position-balanced totals — because the contract is a NOISE-BAND
+        claim (tracing-on within +-3% of off on this box), and sequential
+        legs cannot distinguish a 1% instrumentation cost from host
+        drift. The ``trace-ab-delta`` row derives from this leg's paired
+        fields; the ON arm's trace shard is also sanity-checked non-empty
+        (a silently disarmed tracer would 'prove' a zero overhead no one
+        is paying)."""
+        import shutil
+
+        if rounds % 2:
+            rounds += 1  # even rounds: ABBA position balance
+        dims = dict(vocab_size=8192) if on_tpu else dict(
+            hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
+        dataset = ("synthetic-lm" if family == "gpt2"
+                   else "synthetic-seq2seq")
+        trace_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", "trace_ab"))
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+        def build(tag: str, trace: bool) -> TrainLoop:
+            # both arms get a (fresh) run dir so construction is
+            # symmetric; only the tracer differs. trace is passed as an
+            # explicit bool: False FORCES the control arm off even when
+            # DPT_TRACE is exported (the env fallback would otherwise
+            # trace both arms and "prove" a zero overhead nobody pays)
+            run_dir = os.path.join(trace_dir, tag)
+            os.makedirs(run_dir, exist_ok=True)
+            wl = create_model_from_config(
+                model_family=family, model_size=size, seq_len=seq_len,
+                dtype=dtype, **dims)
+            data = load_data_from_args(
+                "train", batch_size=batch, dataset=dataset,
+                seq_len=seq_len, vocab_size=dims["vocab_size"], seed=0,
+                num_loader_proc=2)
+            return TrainLoop(model=wl, data=data, batch_size=batch,
+                             microbatch=microbatch or batch, lr=1e-4,
+                             ema_rate="0.9999", learning_steps=0,
+                             log_interval=10 ** 9, save_interval=10 ** 9,
+                             mesh=make_mesh(dp=-1), checkpoint_dir=run_dir,
+                             seed=0, sanitize=True, trace=trace)
+
+        warm = 7 if on_tpu else 2
+
+        def warmup(loop: TrainLoop) -> None:
+            m = loop.run_step(loop.next_batch())
+            float(jax.device_get(m["loss"]))
+            for _ in range(warm):
+                m = loop.run_step(loop.next_batch())
+            float(jax.device_get(m["loss"]))
+
+        def window(loop: TrainLoop) -> float:
+            t0 = time.perf_counter()
+            for _ in range(window_steps):
+                m = loop.run_step(loop.next_batch())
+            float(jax.device_get(m["loss"]))
+            return time.perf_counter() - t0
+
+        from distributed_pipeline_tpu.obs.trace import trace_path
+
+        # Two live TrainLoops double the device residency (the same
+        # situation measure_prefetch_ab handles): an HBM OOM halves the
+        # batch and retries the PAIRED protocol instead of erroring out
+        # the overhead-guard leg. The row's "batch" reports what ran.
+        requested_batch = batch
+        while True:
+            try:
+                loop_off = build("off", trace=False)
+                try:
+                    assert not loop_off.tracer.enabled  # a traced OFF
+                    # arm would invalidate the whole comparison
+                    warmup(loop_off)
+                    loop_on = build("on", trace=True)
+                    try:
+                        warmup(loop_on)
+                        off_dts: list = []
+                        on_dts: list = []
+                        for r in range(rounds):
+                            pair = ((loop_off, off_dts), (loop_on, on_dts))
+                            for loop, dts in (pair[::-1] if r % 2
+                                              else pair):
+                                dts.append(window(loop))
+                        traced_events = 0
+                        shard = trace_path(os.path.join(trace_dir, "on"),
+                                           0)
+                        if os.path.exists(shard):
+                            with open(shard) as f:
+                                traced_events = sum(
+                                    1 for line in f if line.strip())
+                        loop_on.tracer.close()
+                    finally:
+                        loop_on.stop_sanitizer()
+                finally:
+                    loop_off.stop_sanitizer()
+            except (LegTimeout, BenchInterrupted):
+                raise
+            except Exception as e:
+                msg = str(e)
+                if (batch <= 1 or ("RESOURCE_EXHAUSTED" not in msg
+                                   and "out of memory"
+                                   not in msg.lower())):
+                    raise
+                print(f"# {name}: batch {batch} OOM with two live loops; "
+                      f"retrying A/B at {batch // 2}", file=sys.stderr,
+                      flush=True)
+                batch //= 2
+                microbatch = min(microbatch, batch) if microbatch else 0
+                shutil.rmtree(trace_dir, ignore_errors=True)
+                continue
+            break
+        n_steps = rounds * window_steps
+        off_sps = n_steps / sum(off_dts)
+        on_sps = n_steps / sum(on_dts)
+        delta_pct = 100.0 * (sum(off_dts) / sum(on_dts) - 1.0)
+        if not traced_events:
+            return {"name": name,
+                    "error": "trace arm wrote no events — the A/B "
+                             "measured nothing (tracer disarmed?)"}
+        fallback = {"ab_batch_fallback": True} \
+            if batch != requested_batch else {}
+        tps = (n_steps * batch * seq_len * jax.process_count()
+               / sum(on_dts))
+        fpt = transformer_train_flops_per_token(
+            loop_on.n_params, loop_on.workload.num_layers,
+            loop_on.workload.hidden_size, seq_len)
+        return {
+            "name": name,
+            "tokens_per_sec_per_chip": round(tps / jax.device_count(), 1),
+            "steps_per_s": round(on_sps, 4),
+            "mfu": round(mfu(tps, fpt), 4),
+            "n_params": loop_on.n_params,
+            "batch": batch, "microbatch": microbatch or batch,
+            "seq_len": seq_len,
+            "trace_events": traced_events,
+            "compile_s": round(loop_on.compile_time_s or 0.0, 3),
+            "ab_method": "paired-interleaved",
+            "ab_rounds": rounds, "ab_window_steps": window_steps,
+            "ab_off_steps_per_s": round(off_sps, 4),
+            "ab_delta_pct": round(delta_pct, 2),
+            **fallback,
+        }
+
     def measure_zero1_ab(name: str, *, batch: int, microbatch: int,
                          seq_len: int, window_steps: int, rounds: int,
                          size: str = "base", cpu_hidden: int = 256,
@@ -1114,6 +1264,17 @@ def main() -> None:
             microbatch=64 if on_tpu else 8, seq_len=128,
             window_steps=10 if on_tpu else 6,
             rounds=6 if on_tpu else 8)),
+        # Trace-overhead guard (ISSUE 12): span tracing ON vs OFF at the
+        # headline settings, paired-interleaved like the other A/B twins.
+        # The contract is a noise-band claim — tracing must cost within
+        # +-3% on the headline leg, or it cannot be left armed on real
+        # runs. The trace-ab-delta row below derives from this leg.
+        ("diffuseq-base-seq128-trace", functools.partial(
+            measure_trace_ab, "diffuseq-base-seq128-trace",
+            family="diffuseq", size="base", seq_len=128, batch=bsz(256),
+            microbatch=bsz(256) // 4 or 1,
+            window_steps=10 if on_tpu else 4,
+            rounds=6 if on_tpu else 32)),
         # Serving decode legs (ISSUE 7): continuous-batching decode
         # tokens/s/chip at 1 / 8 / 64 slots plus time-to-first-token,
         # through the prefill/decode AOT split + paged KV cache
@@ -1447,6 +1608,23 @@ def main() -> None:
                   "window_steps": on["ab_window_steps"],
                   "prefetch_depth": on.get("prefetch_depth"),
                   "dispatch_lag": on.get("dispatch_lag")})
+
+        # Trace-overhead row (ISSUE 12): tracing-off vs tracing-on at
+        # identical settings from ONE paired-interleaved leg — the
+        # "observability is affordable" acceptance number (|delta| within
+        # the box's +-3% noise band).
+        tr = next((c for c in configs
+                   if c.get("name") == "diffuseq-base-seq128-trace"
+                   and "ab_delta_pct" in c), None)
+        if tr:
+            emit({"name": "trace-ab-delta",
+                  "off_steps_per_s": tr["ab_off_steps_per_s"],
+                  "on_steps_per_s": tr["steps_per_s"],
+                  "delta_pct": tr["ab_delta_pct"],
+                  "trace_events": tr["trace_events"],
+                  "method": "paired-interleaved",
+                  "rounds": tr["ab_rounds"],
+                  "window_steps": tr["ab_window_steps"]})
 
         # ZeRO-1 acceptance row (ISSUE 9): the headline-twin A/B's two
         # numbers in one place — per-replica optimizer-bytes ratio (~dp)

@@ -19,6 +19,14 @@ GL008 hand-wired-sharding  NamedSharding constructed (or a PartitionSpec
                            passed directly as a sharding) outside the
                            partition engine — sharding belongs in rule
                            tables (parallel/partition.py), not call sites
+GL009 ad-hoc-timing        a raw time.time()/perf_counter()/monotonic()
+                           delta booked straight into a metric sink
+                           (logkv*, or += into a metrics mapping) outside
+                           utils/perf.py and obs/ — wall-time accounting
+                           belongs to the perf/obs abstractions
+                           (StallBreakdown, GoodputTracker, ServingTracker,
+                           obs.trace spans/Stopwatch), where one owner
+                           keeps the trace and the ledgers consistent
 """
 
 from __future__ import annotations
@@ -786,3 +794,144 @@ class HandWiredSharding(Rule):
                     and len(parent.args) >= 2 and parent.args[1] is node:
                 return True
         return False
+
+
+# --------------------------------------------------------------------- GL009
+
+# The sanctioned owners of wall-time deltas that become metrics. perf.py
+# holds the training-side accounting (StallBreakdown/GoodputTracker/
+# StepTimer/EventStats); everything under obs/ holds the tracing layer
+# (spans, Stopwatch) — both are WHERE the subtraction is supposed to live.
+_GL009_EXEMPT_SUFFIXES = ("utils/perf.py",)
+_GL009_EXEMPT_DIRS = ("/obs/",)
+_GL009_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic"}
+
+
+def _gl009_exempt(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return (any(p.endswith(s) for s in _GL009_EXEMPT_SUFFIXES)
+            or any(d in p for d in _GL009_EXEMPT_DIRS))
+
+
+@register
+class AdHocTiming(Rule):
+    """GL009: a raw clock delta (``time.time()``/``perf_counter()``/
+    ``monotonic()`` subtraction) booked straight into a metric sink —
+    a ``logkv*`` call, or ``+=`` into a metrics mapping entry — outside
+    ``utils/perf.py``/``obs/``. Scattered ad-hoc timing is exactly what
+    made "where did the wall time go" unanswerable before the goodput
+    ledger: each such delta is a category no fold accounts for, invisible
+    to the trace timeline, and (for ``time.time()``) vulnerable to clock
+    steps. Book the window through the owning abstraction instead
+    (StallBreakdown/GoodputTracker/ServingTracker ``add``/``timed``, an
+    ``obs.trace`` span, or ``obs.trace.Stopwatch`` when a raw number is
+    genuinely all that's needed). Computing a delta for control flow or
+    a result dict stays legal — only the direct delta->metric-sink flow
+    is flagged, so the rule gates without drowning the baseline."""
+
+    code = "GL009-ad-hoc-timing"
+    description = ("raw time.time()/perf_counter() delta booked into a "
+                   "metric sink outside utils/perf.py|obs/ — use the "
+                   "perf/obs timing abstractions")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if _gl009_exempt(module.path):
+            return
+        scopes: List[List[ast.stmt]] = [module.tree.body]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from self._scan_scope(module, body)
+
+    # -- helpers
+
+    def _is_clock_call(self, module: Module, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) \
+            and module.resolve(node.func) in _GL009_CLOCKS
+
+    def _is_delta(self, module: Module, node: ast.AST) -> bool:
+        return (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and (self._is_clock_call(module, node.left)
+                     or self._is_clock_call(module, node.right)))
+
+    def _delta_in(self, module: Module, tree: ast.AST,
+                  delta_names: Set[str]) -> Optional[ast.AST]:
+        """A clock-delta expression (or a name bound to one in this
+        scope) inside ``tree``, not descending into nested functions."""
+        stack: List[ast.AST] = [tree]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_NODES):
+                continue
+            if self._is_delta(module, n):
+                return n
+            if isinstance(n, ast.Name) \
+                    and isinstance(getattr(n, "ctx", None), ast.Load) \
+                    and n.id in delta_names:
+                return n
+            stack.extend(ast.iter_child_nodes(n))
+        return None
+
+    def _scan_scope(self, module: Module,
+                    body: List[ast.stmt]) -> Iterator[Finding]:
+        # flattened source-order walk of the scope's own statements
+        # (nested defs are their own scope), like GL003
+        stmts: List[ast.stmt] = []
+
+        def flatten(ss: List[ast.stmt]) -> None:
+            for s in ss:
+                if isinstance(s, _FUNC_NODES[:2]) \
+                        or isinstance(s, ast.ClassDef):
+                    continue
+                stmts.append(s)
+                for field in ("body", "orelse", "finalbody"):
+                    flatten(getattr(s, field, []) or [])
+                for h in getattr(s, "handlers", []) or []:
+                    flatten(h.body)
+
+        flatten(body)
+        delta_names: Set[str] = set()
+        for s in stmts:
+            # a name bound to a clock delta is a delta one hop later;
+            # any other rebind clears it
+            if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                    and isinstance(s.targets[0], ast.Name):
+                if self._is_delta(module, s.value):
+                    delta_names.add(s.targets[0].id)
+                else:
+                    delta_names.discard(s.targets[0].id)
+            # sink 1: logkv*(..., <delta>) — the logger books the raw
+            # number with no category any ledger accounts for. Shallow
+            # nodes only: nested statements are flattened separately.
+            for call in (n for n in _shallow_nodes(s)
+                         if isinstance(n, ast.Call)):
+                func = call.func
+                name = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name)
+                        else "")
+                if not name.startswith("logkv"):
+                    continue
+                for arg in list(call.args) + [k.value for k in
+                                              call.keywords]:
+                    hit = self._delta_in(module, arg, delta_names)
+                    if hit is not None:
+                        yield module.finding(
+                            self, hit,
+                            "raw clock delta logged as a metric — book "
+                            "the window through perf/obs (StallBreakdown/"
+                            "GoodputTracker add, a trace span, or "
+                            "obs.trace.Stopwatch) so the goodput fold "
+                            "and the timeline account for it")
+            # sink 2: metrics_map[key] += <delta> (the reference
+            # logger's wall-time accumulator pattern)
+            if isinstance(s, ast.AugAssign) and isinstance(s.op, ast.Add) \
+                    and isinstance(s.target, ast.Subscript):
+                hit = self._delta_in(module, s.value, delta_names)
+                if hit is not None:
+                    yield module.finding(
+                        self, hit,
+                        "raw clock delta accumulated into a metrics "
+                        "mapping — use obs.trace.Stopwatch (or a perf "
+                        "tracker) as the delta's owner")

@@ -30,6 +30,7 @@ from .goodput import (
     read_journal,
     read_serving_records,
     replica_dir,
+    replica_id,
     serving_journal_path,
     serving_record_path,
 )
@@ -47,6 +48,6 @@ __all__ = [
     "beacon_path", "goodput_record_path", "read_attempts", "read_beacons",
     "read_goodput_records",
     "aggregate_serving", "list_replica_dirs", "read_journal",
-    "read_serving_records", "replica_dir", "serving_journal_path",
-    "serving_record_path",
+    "read_serving_records", "replica_dir", "replica_id",
+    "serving_journal_path", "serving_record_path",
 ]

@@ -60,7 +60,8 @@ __all__ = [
     "beacon_path", "read_beacons", "beacon_max_step", "beacon_mtimes",
     "attempts_path", "append_attempt", "read_attempts",
     "goodput_record_path", "read_goodput_records", "aggregate_run",
-    "replica_dir", "list_replica_dirs", "serving_journal_path",
+    "replica_dir", "replica_id", "list_replica_dirs",
+    "serving_journal_path",
     "read_journal", "serving_record_path", "read_serving_records",
     "aggregate_serving",
 ]
@@ -176,7 +177,17 @@ def list_replica_dirs(fleet_dir: str) -> List[str]:
     for path in glob.glob(os.path.join(fleet_dir, "replica_*")):
         if _REPLICA_RE.search(path) and os.path.isdir(path):
             out.append(path)
-    return sorted(out, key=lambda p: int(_REPLICA_RE.search(p).group(1)))
+    return sorted(out, key=replica_id)
+
+
+def replica_id(replica_dir_path: str) -> int:
+    """Replica index encoded in a replica dir path — the one parser for
+    the naming :func:`replica_dir` writes (import-light readers must not
+    each grow their own slice/regex of it)."""
+    m = _REPLICA_RE.search(replica_dir_path)
+    if m is None:
+        raise ValueError(f"not a replica dir: {replica_dir_path!r}")
+    return int(m.group(1))
 
 
 def serving_journal_path(fleet_dir: str) -> str:

@@ -80,6 +80,15 @@ class ServeSettings(S):
                                   "run share the paged-KV pages holding "
                                   "that prefix (refcounted; evicted LRU "
                                   "under pool pressure)")
+    trace: bool = _(False, "span tracing (obs/): replicas book per-request "
+                           "serve spans (router-propagated trace ids), "
+                           "engine prefill/decode spans, and hot-swap "
+                           "drain/load windows into per-replica "
+                           "trace_rank0.jsonl shards; export the whole "
+                           "fleet as ONE Perfetto timeline with python -m "
+                           "distributed_pipeline_tpu.obs.export "
+                           "<fleet_dir>; DPT_TRACE arms it too; off = "
+                           "zero-cost no-op")
 
     # ------------------------------------------------- traffic (ISSUE 11)
     traffic: Literal["steps", "poisson", "bursty", "diurnal"] = _(

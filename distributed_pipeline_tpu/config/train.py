@@ -54,6 +54,19 @@ class GeneralSettings(S):
                                          "eval decoding (diffuseq only)")
     profile_dir: str = _("", "capture a jax.profiler trace of a few steps "
                              "into this directory (TensorBoard format)")
+    profile_steps: str = _("", "jax.profiler capture window as 'A:B' loop "
+                               "steps counted from loop entry (with "
+                               "--profile_dir; empty = the default 3:8 "
+                               "window past compilation) — the XLA-level "
+                               "view next to the obs/ span timeline")
+    trace: bool = _(False, "span tracing (obs/): book step/save/restore/"
+                           "compile/eval spans into trace_rank{k}.jsonl "
+                           "in the run dir, exportable to a Perfetto "
+                           "timeline with python -m "
+                           "distributed_pipeline_tpu.obs.export; the "
+                           "DPT_TRACE env arms it too (reaches every "
+                           "worker of a launcher ring, incl. "
+                           "--config_json runs); off = zero-cost no-op")
     sanitize: bool = _(False, "runtime sanitizer mode: count every XLA "
                               "compile into a recompile_count gauge "
                               "(jax_log_compiles) and disallow implicit "

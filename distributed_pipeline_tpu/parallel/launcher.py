@@ -27,9 +27,10 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..chaos import goodput as goodput_lib
+from ..obs import trace as trace_lib
 from .dist import AUTORUN_ENV_FLAG, find_free_port, is_available
 
 __all__ = [
@@ -677,6 +678,10 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
     consecutive_failures = 0
     prev_t_exit = 0.0
     prev_max_step: Optional[int] = None
+    # Supervision trace (obs/, armed by DPT_TRACE): attempt spans, backoff
+    # windows, and watchdog kills land in trace_launcher*.jsonl in the run
+    # dir — created lazily once the worker handshake reveals the dir.
+    tracer: Any = trace_lib.NULL
     try:
         while True:
             t_spawn = time.time()
@@ -711,6 +716,21 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                     goodput_lib.append_attempt(run_dir, record)
                 except OSError as e:
                     print(f"{label} attempts.jsonl write failed: {e}")
+                if tracer is trace_lib.NULL:
+                    tracer = trace_lib.tracer_for(
+                        run_dir, f"launcher_{tag}" if tag else "launcher")
+            if tracer.enabled:
+                tracer.complete(
+                    f"attempt {attempt}", "supervise", t_spawn,
+                    t_exit - t_spawn,
+                    args={"rc": code, "steps": record["steps"],
+                          "nprocs": nprocs_a,
+                          "devices_per_proc": devices_a})
+                if ring_status.get("hung"):
+                    tracer.instant(
+                        "watchdog_kill", "supervise", t=t_exit,
+                        args={"hang_s": ring_status.get("hang_s"),
+                              "kind": ring_status.get("hang_kind")})
             prev_t_exit = t_exit
             if record["end_step"] is not None:
                 prev_max_step = max(prev_max_step or 0, record["end_step"])
@@ -748,8 +768,15 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                   f"{budget.spent()}/{max_restarts} (window "
                   f"{restart_window_s:.0f}s), backoff {backoff:.1f}s")
             if backoff > 0:
+                if tracer.enabled:
+                    # booked up front: the sleep below IS the window
+                    tracer.complete("backoff", "supervise", time.time(),
+                                    backoff,
+                                    args={"consecutive_failures":
+                                          consecutive_failures})
                 time.sleep(backoff)
     finally:
+        tracer.close()
         try:
             os.unlink(run_dir_file)
         except OSError:

@@ -126,6 +126,16 @@ def _serve_single(settings: ServeSettings) -> dict:
     from ..utils import logger
     from .sample import load_run
 
+    if settings.trace:
+        # tracing instruments the FLEET protocol layers (per-request
+        # router trace ids, replica worker spans); the in-process
+        # single-replica path has no run-dir artifacts to stitch — say
+        # so instead of silently writing nothing (a user would otherwise
+        # conclude tracing is broken)
+        print("# serve: --trace instruments fleet mode (--replicas N); "
+              "ignored on the single-replica path", file=sys.stderr,
+              flush=True)
+
     mesh = make_mesh()
     wl, params, _targs, step, which = load_run(
         settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
@@ -256,7 +266,8 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
 
     rid = settings.replica_id
     paths = ReplicaPaths.at(settings.fleet_worker_dir, rid)
-    proto = WorkerProtocol(paths, rid)
+    proto = WorkerProtocol(paths, rid,
+                           trace_armed=True if settings.trace else None)
     pin = proto.startup()  # inbox cleared; params pin from a prior swap
 
     plan = _resolve_chaos_plan(settings)
@@ -292,6 +303,21 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     def _restore_params(target: str):
         # the abstract target's shardings place the tree during restore
         return ckpt_lib.restore_checkpoint(target, abstract)
+
+    def _engine_step() -> None:
+        """One scheduler step, span-attributed by phase: the prefill-vs-
+        decode split is read off the server's own counters, so the
+        engine track shows exactly what the scheduler decided."""
+        if not proto.tracer.enabled:
+            server.step()
+            return
+        p0 = server.prefill_steps
+        t0_wall = time.time()
+        server.step()
+        proto.tracer.complete(
+            "prefill" if server.prefill_steps > p0 else "decode_span",
+            "engine", t0_wall, time.time() - t0_wall,
+            args={"in_flight": len(in_flight)})
 
     # Warmup BEFORE announcing ready: the prefill/decode AOT compiles run
     # here, so the first routed request's TTFT is service time, not
@@ -340,7 +366,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
         nonlocal tick
         with proto.tracker.timed("drain_s"):
             while server.busy:
-                server.step()
+                _engine_step()
                 tick += 1
                 proto.write_beacon(tick)
         _report_done()
@@ -384,7 +410,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
                 admitted += 1
                 moved = True
             if server.busy:
-                server.step()
+                _engine_step()
                 moved = True
             _report_done()
             tick += 1
@@ -396,10 +422,11 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     # graceful stop: drain whatever is still in flight before exiting 0
     with proto.tracker.timed("drain_s"):
         while server.busy:
-            server.step()
+            _engine_step()
             tick += 1
             proto.write_beacon(tick)
     _report_done()
+    proto.tracer.close()
     summary = {"ticks": tick, "admitted": admitted, "completed": completed,
                "tokens": tokens_out, "params_step": current_step[0],
                **server.prefix_stats()}
@@ -433,6 +460,13 @@ def _fleet_main(settings: ServeSettings) -> dict:
     fleet_dir = settings.fleet_dir or os.path.join(
         settings.checkpoint_path, "fleet")
     os.makedirs(fleet_dir, exist_ok=True)
+
+    if settings.trace:
+        # arm tracing fleet-wide: the env rides the launcher's worker
+        # environment to every replica attempt (worker spans) and arms
+        # the supervisor threads' launcher shards in the replica dirs
+        from ..obs.trace import TRACE_ENV
+        os.environ[TRACE_ENV] = "1"
 
     plan = _resolve_chaos_plan(settings)
     if plan is not None:

@@ -1,0 +1,223 @@
+"""Live run/fleet status: a read-only aggregator over the telemetry files.
+
+Tails the artifacts every layer already writes — per-rank beacons,
+``attempts.jsonl``, per-replica ``ready.json``/``serving`` snapshots, the
+router ``journal.jsonl`` — and prints a fleet-wide status table: per-rank
+or per-replica health, step/tick progress, goodput, in-flight requests,
+and TTFT percentiles. NEVER imports jax (it must be runnable next to a
+wedged run without competing for the machine), never writes into the run
+dir, and reads with the same torn-tolerant readers the goodput fold uses,
+so a mid-write beacon or a killed router's half line can't crash it.
+
+    python -m distributed_pipeline_tpu.run.status <run_or_fleet_dir>
+    python -m distributed_pipeline_tpu.run.status <dir> --watch 2
+    python -m distributed_pipeline_tpu.run.status <dir> --export t.json \
+        --prom metrics.prom          # one-shot Perfetto + Prometheus dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, List, Optional
+
+from ..chaos import goodput
+from ..obs import export as export_lib
+
+__all__ = ["fleet_status", "main", "render", "run_status", "status"]
+
+
+def _age(now: float, t: Any) -> Optional[float]:
+    try:
+        return max(0.0, now - float(t)) if t else None
+    except (TypeError, ValueError):
+        return None
+
+
+# ------------------------------------------------------------ training run
+
+def run_status(run_dir: str, now: Optional[float] = None,
+               stale_s: float = 10.0) -> dict:
+    """Training-run snapshot: one row per rank beacon + attempt summary +
+    the goodput fold so far."""
+    now = time.time() if now is None else now
+    rows = []
+    for rank, b in sorted(goodput.read_beacons(run_dir).items()):
+        age = _age(now, b.get("t"))
+        gp = b.get("goodput") if isinstance(b.get("goodput"), dict) else {}
+        rows.append({
+            "rank": rank,
+            "attempt": b.get("attempt"),
+            "step": b.get("step"),
+            "beacon_age_s": round(age, 1) if age is not None else None,
+            "state": ("stale" if age is not None and age > stale_s
+                      else "advancing"),
+            "goodput": gp.get("goodput"),
+            "steady_recompiles": b.get("steady_recompile_count"),
+        })
+    attempts = goodput.read_attempts(run_dir)
+    agg = goodput.aggregate_run(run_dir) if (attempts or rows) else None
+    return {
+        "kind": "run",
+        "dir": os.path.abspath(run_dir),
+        "ranks": rows,
+        "attempts": len(attempts),
+        "last_rc": attempts[-1].get("rc") if attempts else None,
+        "goodput": (round(agg["goodput"], 4) if agg else None),
+        "accounted_frac": (round(agg["accounted_frac"], 4) if agg
+                           else None),
+    }
+
+
+# ------------------------------------------------------------ serving fleet
+
+def fleet_status(fleet_dir: str, now: Optional[float] = None,
+                 stale_s: float = 10.0) -> dict:
+    """Fleet snapshot: per-replica health (ready/stale/init), serving
+    version + attempt, the LIVE serving-time decomposition from each
+    beacon, and router-journal request/TTFT counters."""
+    from ..serving.fleet import ReplicaPaths, read_json_file
+
+    now = time.time() if now is None else now
+    rows = []
+    for rd in goodput.list_replica_dirs(fleet_dir):
+        rid = goodput.replica_id(rd)
+        paths = ReplicaPaths.at(rd, rid)
+        ready = read_json_file(paths.ready_path)
+        b = goodput.read_beacons(rd).get(0) or {}
+        age = _age(now, b.get("t"))
+        snap = b.get("serving") if isinstance(b.get("serving"), dict) else {}
+        if ready is None and not b:
+            state = "init"
+        elif age is not None and age > stale_s:
+            state = "stale"
+        elif ready is None:
+            state = "starting"
+        else:
+            state = "ready"
+        rows.append({
+            "replica": rid,
+            "state": state,
+            "attempt": b.get("attempt", ready.get("attempt")
+                             if ready else None),
+            "params_step": ready.get("params_step") if ready else None,
+            "tick": b.get("step"),
+            "beacon_age_s": round(age, 1) if age is not None else None,
+            "serving_s": snap.get("serving_s"),
+            "drain_s": snap.get("drain_s"),
+            "swap_s": snap.get("swap_s"),
+            "attempts": len(goodput.read_attempts(rd)),
+        })
+    events = goodput.read_journal(goodput.serving_journal_path(fleet_dir))
+    # one owner for the journal fold (obs.export.journal_counts): the
+    # status table and the Prometheus snapshot can never disagree
+    counts = export_lib.journal_counts(events)
+    for row in rows:
+        row["in_flight"] = counts["assigned"].get(row["replica"], 0)
+    return {
+        "kind": "fleet",
+        "dir": os.path.abspath(fleet_dir),
+        "replicas": rows,
+        **{k: v for k, v in counts.items()
+           if k not in ("assigned", "ttfts")},
+    }
+
+
+def status(d: str, now: Optional[float] = None,
+           stale_s: float = 10.0) -> dict:
+    return (fleet_status(d, now, stale_s)
+            if export_lib.is_fleet_dir(d) else run_status(d, now, stale_s))
+
+
+# -------------------------------------------------------------- rendering
+
+def _table(headers: List[str], rows: List[List[Any]]) -> str:
+    cells = [[("-" if v is None else str(v)) for v in row] for row in rows]
+    widths = [max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*row) for row in cells]
+    return "\n".join(lines)
+
+
+def render(snap: dict) -> str:
+    out = [f"[{snap['kind']}] {snap['dir']}"]
+    if snap["kind"] == "fleet":
+        headers = ["replica", "state", "attempt", "params_step", "tick",
+                   "beacon_age_s", "in_flight", "serving_s", "drain_s",
+                   "swap_s", "attempts"]
+        out.append(_table(headers, [[r.get(h) for h in headers]
+                                    for r in snap["replicas"]]))
+        out.append(
+            f"requests: {snap['submitted']} submitted / "
+            f"{snap['completed']} completed / {snap['in_flight']} in "
+            f"flight / {snap['replayed']} replayed   "
+            f"ttft p50={snap['ttft_p50_s']}s p95={snap['ttft_p95_s']}s")
+    else:
+        headers = ["rank", "state", "attempt", "step", "beacon_age_s",
+                   "goodput", "steady_recompiles"]
+        out.append(_table(headers, [[r.get(h) for h in headers]
+                                    for r in snap["ranks"]]))
+        out.append(f"attempts: {snap['attempts']} (last rc "
+                   f"{snap['last_rc']})   run goodput: {snap['goodput']} "
+                   f"(accounted {snap['accounted_frac']})")
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------------- CLI
+
+def main(argv: Optional[List[str]] = None) -> dict:
+    ap = argparse.ArgumentParser(
+        description="Live, read-only run/fleet status from the telemetry "
+                    "files (beacons, attempts.jsonl, ready.json, the "
+                    "router journal). No jax import, no writes into the "
+                    "run dir.")
+    ap.add_argument("dir", help="run dir (training) or fleet dir (serving)")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="S",
+                    help="refresh every S seconds until interrupted "
+                         "(0 = print once)")
+    ap.add_argument("--stale_s", type=float, default=10.0,
+                    help="beacon age that flags a rank/replica as stale")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the snapshot as one JSON line instead of "
+                         "the table")
+    ap.add_argument("--export", default="", metavar="PATH",
+                    help="also write the Perfetto timeline JSON "
+                         "(obs.export) to PATH and exit")
+    ap.add_argument("--prom", default="", metavar="PATH",
+                    help="also write a Prometheus textfile snapshot to "
+                         "PATH")
+    ns = ap.parse_args(argv)
+    if ns.export:
+        summary = export_lib.write_outputs(
+            ns.dir, out=ns.export, prom=ns.prom)
+        print(json.dumps(summary))
+        return summary
+    if ns.prom:
+        lines = export_lib.prometheus_lines(ns.dir)
+        with open(ns.prom, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        summary = {"dir": os.path.abspath(ns.dir),
+                   "prometheus": os.path.abspath(ns.prom),
+                   "metrics": len(lines)}
+        print(json.dumps(summary))
+        return summary
+    while True:
+        snap = status(ns.dir, stale_s=ns.stale_s)
+        print(json.dumps(snap) if ns.as_json else render(snap), flush=True)
+        if ns.watch <= 0:
+            return snap
+        try:
+            time.sleep(ns.watch)
+        except KeyboardInterrupt:
+            return snap
+        if not ns.as_json:
+            print("", file=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -278,6 +278,11 @@ def main(namespace: argparse.Namespace) -> None:
         # the per-run partition-rule override (parallel/partition.py).
         shard_optimizer=args.shard_optimizer,
         partition_rules=parse_partition_rules(args.partition_rules),
+        # Span tracing (obs/): --trace arms explicitly; the default
+        # defers to the DPT_TRACE launcher env, so supervised rings
+        # armed at the launcher trace every attempt.
+        trace=True if args.trace else None,
+        profile_steps=args.profile_steps,
     )
 
     # Exact-resume data order: fast-forward both streams so the continued

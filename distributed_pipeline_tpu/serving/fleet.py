@@ -67,6 +67,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..chaos import goodput as goodput_lib
 from ..chaos.inject import COMMIT_MARKERS
+from ..obs import trace as trace_lib
 
 __all__ = [
     "ReplicaPaths", "ReplicaClient", "WorkerProtocol", "ServingTracker",
@@ -175,6 +176,11 @@ class ServingTracker:
         self.t_start = (t_start if t_start is not None
                         else float(env) if env else time.time())
         self._cats = {c: 0.0 for c in self.CATEGORIES}
+        # optional obs/ span sink (WorkerProtocol wires its tracer in):
+        # timed() then books a span from the SAME measured seconds, so
+        # the hot-swap drain/load windows on the timeline are exactly the
+        # ledger's drain_s/swap_s — they can never disagree
+        self.tracer = trace_lib.NULL
 
     def book(self, category: str, seconds: float) -> None:
         self._cats[category] += max(0.0, seconds)
@@ -182,10 +188,15 @@ class ServingTracker:
     @contextlib.contextmanager
     def timed(self, category: str):
         t0 = time.perf_counter()
+        t0_wall = time.time()
         try:
             yield
         finally:
-            self.book(category, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.book(category, dt)
+            if self.tracer.enabled:
+                name = category[:-2] if category.endswith("_s") else category
+                self.tracer.complete(name, "swap", t0_wall, dt)
 
     def snapshot(self) -> Dict[str, float]:
         wall = max(0.0, time.time() - self.t_start)
@@ -203,12 +214,27 @@ class WorkerProtocol:
     (tests/_fleet_child.py) so the two can never drift apart."""
 
     def __init__(self, paths: ReplicaPaths, replica_id: int,
-                 attempt: Optional[int] = None) -> None:
+                 attempt: Optional[int] = None,
+                 trace_armed: Optional[bool] = None) -> None:
         self.paths = paths.ensure()
         self.replica_id = replica_id
         self.attempt = (attempt if attempt is not None
                         else int(os.environ.get("DPT_ATTEMPT") or 0))
         self.tracker = ServingTracker()
+        # Span tracing (obs/): one shard per replica worker process,
+        # armed by DPT_TRACE (the fleet parent exports it; the launcher
+        # forwards it to every attempt) or explicitly. Request spans are
+        # booked HERE — at the protocol layer both the real worker and
+        # the jax-free test stand-in share — so the cross-process trace
+        # id propagated by the router cannot drift between them. The
+        # process label is replica-qualified: every replica's shard is
+        # trace_rank0.jsonl in its OWN dir, but span ids must stay
+        # unique across the merged fleet timeline.
+        self.tracer = trace_lib.tracer_for(self.paths.root, 0,
+                                           armed=trace_armed,
+                                           proc=f"r{replica_id}.rank0")
+        self.tracker.tracer = self.tracer
+        self._admits: Dict[int, tuple] = {}  # id -> (trace id, admit wall)
         self._last_swap_id: Optional[int] = None
         # the launcher learns the run dir through the same handshake the
         # trainer uses — that is what points its hang watchdog (and the
@@ -240,6 +266,12 @@ class WorkerProtocol:
         write_json_atomic(self.paths.ready_path, {
             "attempt": self.attempt, "replica": self.replica_id,
             "params_step": int(params_step), "t": time.time()})
+        if self.tracer.enabled:
+            # swap visibility: a ready instant at a NEW params_step marks
+            # the exact moment the replica started serving that version
+            self.tracer.instant("ready", "lifecycle",
+                                args={"params_step": int(params_step),
+                                      "attempt": self.attempt})
 
     # ----------------------------------------------------------- main loop
 
@@ -256,6 +288,12 @@ class WorkerProtocol:
             payload = read_json_file(path)
             if payload is not None:
                 out.append(payload)
+                if self.tracer.enabled:
+                    # first sight of the request on this replica: the
+                    # serve span (booked at write_result) starts here
+                    self._admits.setdefault(
+                        int(payload.get("id", -1)),
+                        (payload.get("trace"), time.time()))
         return out
 
     def consume(self, req_id: int) -> None:
@@ -269,6 +307,17 @@ class WorkerProtocol:
                    "attempt": self.attempt, "t_done": time.time()}
         write_json_atomic(self.paths.result_path(int(payload["id"])),
                           payload)
+        admit = self._admits.pop(int(payload["id"]), None)
+        if admit is not None and self.tracer.enabled:
+            trace_id, t_admit = admit
+            self.tracer.complete(
+                "serve", "request", t_admit,
+                max(0.0, payload["t_done"] - t_admit),
+                trace_id=trace_id,
+                args={"id": int(payload["id"]),
+                      "replica": self.replica_id,
+                      "n_tokens": len(payload.get("tokens") or []),
+                      "replays": payload.get("replays")})
 
     def pending_swap(self) -> Optional[dict]:
         """The swap command not yet acked by THIS process. Re-reading the

@@ -41,6 +41,8 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from ..obs.trace import request_trace_id
+
 __all__ = ["RoutedRequest", "Router"]
 
 
@@ -53,6 +55,13 @@ class RoutedRequest:
     max_new_tokens: int
     submit_t: float                     # wall clock (rides to the worker:
     #                                     TTFT includes queue + replay time)
+    trace_id: str = ""                  # cross-process trace identity: ONE
+    #                                     id per request, derived from the
+    #                                     request id (explicit, never wall-
+    #                                     clock), riding every journal
+    #                                     event and inbox payload so the
+    #                                     worker's spans and the router's
+    #                                     journal stitch into one timeline
     state: str = "pending"              # pending | assigned | done
     replica: Optional[int] = None
     epoch: Optional[int] = None         # replica attempt at assignment
@@ -103,12 +112,14 @@ class Router:
             id=self._req_counter, prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             submit_t=float(submit_t if submit_t is not None
-                           else time.time()))
+                           else time.time()),
+            trace_id=request_trace_id(self._req_counter))
         self.records[rec.id] = rec
         self.queue.append(rec.id)
         # the full prompt rides the journal: recovery must be able to
         # re-place the request without any other artifact surviving
         self._journal({"ev": "submit", "id": rec.id, "t": rec.submit_t,
+                       "trace": rec.trace_id,
                        "prompt": prompt.tolist(),
                        "max_new_tokens": rec.max_new_tokens})
         return rec
@@ -167,7 +178,8 @@ class Router:
             rec.params_step = int(ps) if ps is not None else None
             rec.done_t = now
             self._journal({"ev": "complete", "id": rec.id, "replica": rid,
-                           "t": now, "n_tokens": len(rec.tokens),
+                           "t": now, "trace": rec.trace_id,
+                           "n_tokens": len(rec.tokens),
                            "ttft_s": rec.ttft_s,
                            "params_step": rec.params_step})
 
@@ -183,6 +195,7 @@ class Router:
                 self.replayed += 1
                 self.queue.append(rec.id)
                 self._journal({"ev": "replay", "id": rec.id, "from": rid,
+                               "trace": rec.trace_id,
                                "reason": reason, "t": now,
                                "wasted_s": round(wasted, 6)})
 
@@ -228,9 +241,11 @@ class Router:
             self.clients[rid].submit({
                 "id": rec.id, "prompt": rec.prompt.tolist(),
                 "max_new_tokens": rec.max_new_tokens,
-                "submit_t": rec.submit_t, "replays": rec.replays})
+                "submit_t": rec.submit_t, "replays": rec.replays,
+                "trace": rec.trace_id})
             self._journal({"ev": "assign", "id": rec.id, "replica": rid,
-                           "epoch": rec.epoch, "t": now})
+                           "epoch": rec.epoch, "trace": rec.trace_id,
+                           "t": now})
 
     # ---------------------------------------------------------------- stats
 
@@ -274,7 +289,11 @@ class Router:
                     id=int(ev["id"]),
                     prompt=np.asarray(ev.get("prompt", []), np.int32),
                     max_new_tokens=int(ev.get("max_new_tokens", 1)),
-                    submit_t=float(ev.get("t", 0.0)))
+                    submit_t=float(ev.get("t", 0.0)),
+                    # pre-trace journals lack the field: re-derive the
+                    # same id the writer would have minted
+                    trace_id=str(ev.get("trace")
+                                 or request_trace_id(int(ev["id"]))))
                 router.records[rec.id] = rec
                 router._req_counter = max(router._req_counter, rec.id)
             elif kind == "replay":

@@ -348,15 +348,21 @@ dump_tabular = dumpkvs
 def profile_kv(scopename: str, sync_fn=None):
     """Accumulate wall time into ``wait_<scope>`` (reference logger.py:296-303).
     ``sync_fn`` (e.g. ``jax.block_until_ready`` on a result) makes async device
-    work attributable to the scope."""
+    work attributable to the scope. The interval comes from an
+    ``obs.trace.Stopwatch`` (monotonic, and the GL009-sanctioned owner of
+    ad-hoc timing deltas — a raw ``time.time()`` subtraction here was the
+    rule's dogfooded true positive, and wall-clock steps could book
+    negative or inflated waits)."""
+    from ..obs.trace import Stopwatch
+
     logkey = "wait_" + scopename
-    tstart = time.time()
+    watch = Stopwatch()
     try:
         yield
     finally:
         if sync_fn is not None:
             sync_fn()
-        get_current().name2val[logkey] += time.time() - tstart
+        get_current().name2val[logkey] += watch.lap_s()
 
 
 def profile(n: str):

@@ -47,6 +47,7 @@ from jax.sharding import Mesh
 
 from ..data.device_prefetch import DeviceBatch, prefetch_to_device
 from ..models import Workload
+from ..obs import trace as trace_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition as partition_lib
 from ..parallel.sharding import (
@@ -127,6 +128,8 @@ class TrainLoop:
         recompute_until_step: int = 0,
         shard_optimizer: bool = False,
         partition_rules: Optional[Sequence[Tuple[str, Any]]] = None,
+        trace: Optional[bool] = None,
+        profile_steps: str = "",
     ) -> None:
         # Time-to-signal accounting starts at construction: everything up
         # to the end of the first optimizer step (state init, restore,
@@ -177,9 +180,25 @@ class TrainLoop:
         # SURVEY.md §5.1 rebuild note: a first-class jax.profiler trace hook.
         # A short window a few steps in (past compilation) is captured into
         # profile_dir in TensorBoard format; 0-length dir disables.
+        # --profile_steps "A:B" overrides the window (loop steps, [A, B)) —
+        # the programmatic XLA-level view next to the obs/ span timeline.
         self.profile_dir = profile_dir
         self._profile_window = (3, 8)  # [start, stop) steps after loop entry
+        if profile_steps:
+            try:
+                a, b = (int(x) for x in profile_steps.split(":"))
+            except ValueError:
+                raise ValueError(f"profile_steps must be 'A:B' loop-step "
+                                 f"ints, got {profile_steps!r}") from None
+            if not 0 <= a < b:
+                raise ValueError(f"profile_steps window must satisfy "
+                                 f"0 <= A < B, got {profile_steps!r}")
+            self._profile_window = (a, b)
         self._profiling = False
+        # tri-state: True arms, False forces OFF (an A/B's control arm
+        # must stay untraced even under DPT_TRACE), None defers to the
+        # env (how launcher-supervised rings arm without a CLI flag)
+        self._trace = trace
 
         # Steady-state throughput layer (ISSUE 5): keep the device queue
         # full. prefetch_depth > 0 wraps the data iterator so batches are
@@ -267,6 +286,14 @@ class TrainLoop:
             from ..chaos.goodput import beacon_path
             self.progress_file = beacon_path(self.checkpoint_dir,
                                              jax.process_index())
+        # Span tracing (obs/): armed by the trace flag or DPT_TRACE (the
+        # env rides the launcher's worker environment to every attempt of
+        # every ring, like DPT_PREFETCH_DEPTH). Off -> the NULL tracer:
+        # one attribute check per hook, no span objects, no writes. Spans
+        # are booked from the SAME measured seconds handed to the goodput
+        # tracker, so the trace and the ledger can never disagree.
+        self.tracer = trace_lib.tracer_for(
+            self.checkpoint_dir, jax.process_index(), armed=self._trace)
         # global batch = per-host batch x hosts (reference trainer.py:89)
         self.global_batch = batch_size * jax.process_count()
         dpf = (self.mesh.shape["data"] * self.mesh.shape["fsdp"]
@@ -316,6 +343,7 @@ class TrainLoop:
                          (time.perf_counter() - self._construct_t0)
                          - self.goodput.get("restore_s"))
         self._g_prev_t = time.perf_counter()
+        self._g_prev_wall = time.time()
         self._g_prev_stall = self._stall_sum()
         self._g_prev_compile = self.goodput.get("compile_s")
 
@@ -456,6 +484,7 @@ class TrainLoop:
         # explicit placement, so an implicit transfer here means resume
         # code regressed into a host round-trip.
         t_restore0 = time.perf_counter()
+        t_restore0_wall = time.time()
         with self._sanitize_guard():
             restored = ckpt_lib.restore_resume_state(
                 self.checkpoint_dir,
@@ -500,8 +529,14 @@ class TrainLoop:
         # Restore cost (discovery + orbax reads + walk-back + ownership
         # copies) is goodput overhead — the number a warm resume should
         # shrink, and the per-attempt "resume overhead" attempts.jsonl
-        # records.
-        self.goodput.add("restore_s", time.perf_counter() - t_restore0)
+        # records. The trace span books the SAME seconds.
+        restore_dt = time.perf_counter() - t_restore0
+        self.goodput.add("restore_s", restore_dt)
+        if self.tracer.enabled:
+            self.tracer.complete("restore", "ckpt", t_restore0_wall,
+                                 restore_dt,
+                                 args={"step": self.step,
+                                       "resumed": restored is not None})
         self._resume_step = self.step
 
         self.state = TrainState(
@@ -640,6 +675,12 @@ class TrainLoop:
         step functions and recompiles within a log window)."""
         self.compile_time_s = (self.compile_time_s or 0.0) + seconds
         self.goodput.add("compile_s", seconds)
+        if self.tracer.enabled:
+            # the span re-books the exact seconds the ledger got; the
+            # wall anchor back-dates it so the timeline shows WHEN
+            self.tracer.complete("compile", "compile",
+                                 time.time() - seconds, seconds,
+                                 args={"fn": name})
         logger.logkv_sum("compile_time_s", round(seconds, 3))
         logger.info(f"compiled {name} in {seconds:.2f}s")
 
@@ -758,6 +799,15 @@ class TrainLoop:
                       + (self._stall_sum() - self._g_prev_stall))
             self.goodput.add(
                 "recompute_s", max(0.0, (now - self._g_prev_t) - booked))
+        if self.tracer.enabled:
+            # the step span IS the goodput step-slice (previous run_step
+            # completion -> this one): same boundary, same seconds, so
+            # summing trace step spans reproduces the ledger's step time
+            self.tracer.complete(
+                "step", "train", self._g_prev_wall, now - self._g_prev_t,
+                args={"step": self.step,
+                      "recompute": self.step <= self.recompute_until_step})
+            self._g_prev_wall = time.time()
         self._g_prev_t = now
         self._g_prev_stall = self._stall_sum()
         self._g_prev_compile = self.goodput.get("compile_s")
@@ -803,9 +853,16 @@ class TrainLoop:
         rng = jax.device_put(
             jax.random.fold_in(self._base_rng, 0x7FFF0000 + self.step),
             replicated(self.mesh))
+        t_eval0_wall = time.time()
+        watch = trace_lib.Stopwatch() if self.tracer.enabled else None
         prepared = self._prepare(batch)
         with self.mesh, self._sanitize_guard():
             metrics = self._eval_step(self.state.params, prepared, rng)
+        if watch is not None:
+            # dispatch span only (blocking on the eval output here would
+            # add the per-eval sync async dispatch exists to avoid)
+            self.tracer.complete("eval", "eval", t_eval0_wall,
+                                 watch.lap_s(), args={"step": self.step})
         logger.logkvs_mean({f"eval_{k}": v for k, v in metrics.items()})
         return metrics
 
@@ -1015,6 +1072,7 @@ class TrainLoop:
         logger.logkvs({f"goodput_{k}" if k != "goodput" else k:
                        round(v, 4) for k, v in summary.items()})
         self._write_goodput_record()
+        self.tracer.close()
 
     __call__ = run_loop  # reference trainer.py:357
 
@@ -1040,12 +1098,18 @@ class TrainLoop:
         # guard-clean by test), so anything that trips here is an
         # accidental implicit transfer sneaking into the save path.
         t_save0 = time.perf_counter()
+        t_save0_wall = time.time()
         with self._sanitize_guard():
             self._saver.save(
                 self.checkpoint_dir, self.step, self.state.params,
                 ema={r: self.state.ema[r] for r in self.ema_rates},
                 opt_state=self.state.opt_state, wait=wait)
-        self.goodput.add("save_s", time.perf_counter() - t_save0)
+        save_dt = time.perf_counter() - t_save0
+        self.goodput.add("save_s", save_dt)
+        if self.tracer.enabled:
+            self.tracer.complete("save", "ckpt", t_save0_wall, save_dt,
+                                 args={"step": self.step,
+                                       "async": not wait})
         if self.chaos is not None:
             # crash_in_save faults fire HERE: the async array write is in
             # flight (or, with wait=True, just finalized), so a SIGKILL

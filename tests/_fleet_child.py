@@ -82,12 +82,18 @@ def token_fn(prompt, k: int) -> int:
 def step_decode() -> bool:
     """One 'decode step': every in-flight request gains one token; the
     shared sleep stands in for device time (continuous batching: the
-    step costs one interval regardless of occupancy)."""
+    step costs one interval regardless of occupancy). Traced like the
+    real worker's engine track (DPT_TRACE): one decode_span per step."""
     global completed, tokens_out
     if not in_flight:
         return False
+    t0_wall = time.time() if proto.tracer.enabled else 0.0
     time.sleep(ns.token_interval_s)
     now = time.time()
+    if proto.tracer.enabled:
+        proto.tracer.complete("decode_span", "engine", t0_wall,
+                              now - t0_wall,
+                              args={"in_flight": len(in_flight)})
     for rk in list(in_flight):
         payload, toks = in_flight[rk]
         toks.append(token_fn(payload["prompt"], len(toks)))
@@ -146,4 +152,5 @@ with proto.tracker.timed("drain_s"):
 proto.write_sidecar({"ticks": tick, "admitted": admitted,
                      "completed": completed, "tokens": tokens_out,
                      "params_step": cur_step})
+proto.tracer.close()
 raise SystemExit(0)

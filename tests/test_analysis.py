@@ -33,7 +33,8 @@ def test_catalog_has_all_rules():
                      "GL003-donation-after-use", "GL004-impure-jit",
                      "GL005-recompile-hazard", "GL006-raw-shard-map",
                      "GL007-host-sync-in-loop",
-                     "GL008-hand-wired-sharding"):
+                     "GL008-hand-wired-sharding",
+                     "GL009-ad-hoc-timing"):
         assert expected in got
 
 
@@ -455,6 +456,68 @@ def test_engine_modules_exempt_from_gl008(tmp_path):
             lint(tmp_path, src, name=name))
     assert "GL008-hand-wired-sharding" in codes(
         lint(tmp_path, src, name="serving/somewhere.py"))
+
+
+# ------------------------------------------------------------------- GL009
+
+
+def test_adhoc_timing_delta_into_logkv_flagged(tmp_path):
+    """Both the direct delta and the one-hop name binding are sinks when
+    they reach a logkv* call."""
+    fs = lint(tmp_path, """
+        import time
+        from x import logger
+        def f(t0):
+            logger.logkv("wall_s", time.time() - t0)
+            dt = time.perf_counter() - t0
+            logger.logkv_mean("step_s", round(dt, 3))
+    """)
+    assert sum(1 for f in fs if f.rule == "GL009-ad-hoc-timing") == 2
+
+
+def test_adhoc_timing_accumulator_flagged(tmp_path):
+    """The reference logger's pattern — += a delta into a metrics
+    mapping entry — is the dogfooded true positive (profile_kv, now
+    migrated to obs.trace.Stopwatch)."""
+    fs = lint(tmp_path, """
+        import time
+        def f(metrics, t0):
+            metrics["wait_x"] += time.monotonic() - t0
+    """)
+    assert "GL009-ad-hoc-timing" in codes(fs)
+
+
+def test_adhoc_timing_control_flow_and_results_clean(tmp_path):
+    """Deltas for control flow, return values, and result dicts stay
+    legal — only the direct delta->metric-sink flow gates; rebinding a
+    delta name clears it."""
+    fs = lint(tmp_path, """
+        import time
+        from x import logger
+        def f(t0, deadline):
+            wall = time.time() - t0
+            if wall > deadline:
+                return None
+            dt = time.perf_counter() - t0
+            dt = compute(dt)          # rebind: no longer a raw delta
+            logger.logkv("derived", dt)
+            return {"wall_s": time.time() - t0}
+    """)
+    assert "GL009-ad-hoc-timing" not in codes(fs)
+
+
+def test_adhoc_timing_owner_modules_exempt(tmp_path):
+    src = """
+        import time
+        from x import logger
+        def f(t0):
+            logger.logkv("wall_s", time.time() - t0)
+    """
+    for name in ("utils/perf.py", "obs/trace.py", "obs/export.py"):
+        assert "GL009-ad-hoc-timing" not in codes(
+            lint(tmp_path, src, name=name))
+    assert "GL009-ad-hoc-timing" in codes(
+        lint(tmp_path, src, name="utils/elsewhere.py"))
 
 
 # ----------------------------------------------------------- parse errors

@@ -87,9 +87,9 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert skipped, "1s budget must skip every non-headline leg"
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
-    # (headline + prefetch A/B twin + zero1 A/B + chaos + elastic +
-    # noaccum + moe8 + moe8-cf1 + scan)
-    assert len(final["configs"]) == 9
+    # (headline + prefetch A/B twin + zero1 A/B + trace A/B + chaos +
+    # elastic + noaccum + moe8 + moe8-cf1 + scan)
+    assert len(final["configs"]) == 10
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
@@ -241,6 +241,56 @@ def test_fleet_bench_leg_meets_serving_slos(fleet_bench_run):
     assert row["accounted_frac"] == pytest.approx(1.0, abs=0.05)
     assert row["completed"] == row["requests"]
     assert row["replay_s"] >= 0 and row["fleet_attempts"] >= 4
+
+
+# ------------------------------------------------- trace-overhead A/B leg
+
+@pytest.fixture(scope="module")
+def trace_bench_run(tmp_path_factory):
+    """One bench subprocess filtered to the trace-overhead A/B leg
+    (ISSUE 12): span tracing ON vs OFF, paired-interleaved at headline
+    settings."""
+    tmp = tmp_path_factory.mktemp("trace_bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "240",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        "BENCH_ONLY": "diffuseq-base-seq128-trace",
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("DPT_TRACE", None)  # the leg arms its ON arm itself
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=420)
+    return proc, tmp / "legs.jsonl"
+
+
+def test_trace_ab_leg_emits_paired_delta_row(trace_bench_run):
+    """The trace-overhead guard's schema: the leg carries the paired
+    ab_* fields and a non-empty ON-arm shard (a disarmed tracer would
+    'prove' a zero cost nobody pays), and the derived trace-ab-delta
+    row restates the same paired numbers. The +-3% noise-band claim is
+    about the captured full-run artifact, not asserted here — a loaded
+    CI box would flake it; what IS pinned is that both arms ran
+    interleaved with even (position-balanced) rounds."""
+    proc, artifact = trace_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {r["name"]: r for r in
+            (json.loads(line) for line in
+             artifact.read_text().strip().splitlines())}
+    leg = rows["diffuseq-base-seq128-trace"]
+    assert "error" not in leg and "skipped" not in leg, leg
+    assert leg["ab_method"] == "paired-interleaved"
+    assert leg["ab_rounds"] % 2 == 0
+    assert leg["trace_events"] > 0
+    assert leg["steps_per_s"] > 0 and leg["ab_off_steps_per_s"] > 0
+    delta = rows["trace-ab-delta"]
+    assert delta["delta_pct"] == leg["ab_delta_pct"]
+    assert delta["on_steps_per_s"] == leg["steps_per_s"]
+    assert delta["off_steps_per_s"] == leg["ab_off_steps_per_s"]
+    assert delta["trace_events"] == leg["trace_events"]
 
 
 # ------------------------------------------------ compilation-cache wiring

@@ -632,13 +632,21 @@ def test_fleet_hot_swap_zero_downtime(tmp_path):
         extra = []
 
         def trickle():
-            if len(extra) < 6:
-                extra.append(router.submit(
-                    np.arange(len(extra) + 10,
-                              len(extra) + 14, dtype=np.int32), 6))
             rep = fleet.step_swap(router)
             if rep is not None:
                 swap_report.update(rep)
+            # extras 0-4 flow DURING the roll (the zero-downtime claim);
+            # the LAST one is held until the swap has COMPLETED (checked
+            # after step_swap above, so it lands the same tick), making
+            # the "late requests decode under the new params" assertion
+            # below deterministic — on a loaded box the drive loop can
+            # tick slowly enough that every eagerly-submitted extra lands
+            # on a not-yet-swapped replica (legitimately at the old
+            # version)
+            if len(extra) < 5 or (swap_report and len(extra) < 6):
+                extra.append(router.submit(
+                    np.arange(len(extra) + 10,
+                              len(extra) + 14, dtype=np.int32), 6))
 
         _drive(router, fleet, timeout_s=60.0, tick=trickle)
     finally:

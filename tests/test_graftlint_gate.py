@@ -38,6 +38,9 @@ GATED_PATHS = [
     # the serving-fleet tests drive router/fleet host loops and the
     # replica protocol (GL007 territory once real decode rides them)
     os.path.join(ROOT, "tests", "test_fleet.py"),
+    # the observability tests drive TrainLoop outer loops (GL007) and
+    # exercise the trace/export layer GL009 polices timing flows into
+    os.path.join(ROOT, "tests", "test_obs.py"),
 ]
 
 

@@ -1,0 +1,428 @@
+"""Observability tests (ISSUE 12): tracer roundtrip + explicit IDs,
+torn-tail tolerance (the chaos.goodput.read_journal one-owner reader
+contract), the zero-cost tracing-off path, Chrome-trace export schema
+validity, Prometheus/status snapshots folding the live beacon `serving`
+snapshots, and the chaos-marked fleet e2e — kill_replica + hot-swap under
+DPT_TRACE, exported as ONE timeline where the kill, the replay on the
+sibling, and the drain/swap windows are all visible with one shared
+trace id per request."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.chaos import CHAOS_PLAN_ENV, goodput
+from distributed_pipeline_tpu.obs import export as export_lib
+from distributed_pipeline_tpu.obs import trace as trace_lib
+from distributed_pipeline_tpu.run import status as status_lib
+from distributed_pipeline_tpu.serving.fleet import ServingFleet
+from distributed_pipeline_tpu.serving.router import Router
+
+
+# ================================================================= tracer
+
+def test_tracer_roundtrip_nested_spans_and_explicit_ids(tmp_path):
+    tr = trace_lib.tracer_for(str(tmp_path), 0, armed=True)
+    with tr.span("step", "train", args={"step": 1}):
+        tr.complete("compile", "compile", time.time() - 0.25, 0.25,
+                    args={"fn": "train_step"})
+        tr.instant("mark", "train", trace_id="req00000001")
+    tr.close()
+    events = trace_lib.read_trace(trace_lib.trace_path(str(tmp_path), 0))
+    assert len(events) == 3
+    by = {e["name"]: e for e in events}
+    # IDs are explicit {proc}:{counter} — never wall-clock-derived
+    assert by["step"]["sid"] == "rank0:1"
+    assert all(e["sid"].startswith("rank0:") for e in events)
+    assert len({e["sid"] for e in events}) == 3
+    # nesting: bookings inside the open span carry it as parent
+    assert by["compile"]["parent"] == by["step"]["sid"]
+    assert by["mark"]["parent"] == by["step"]["sid"]
+    assert by["mark"]["trace"] == "req00000001"
+    # completed spans re-book the exact measured seconds
+    assert by["compile"]["dur"] == 0.25
+    assert by["step"]["ph"] == "X" and by["mark"]["ph"] == "i"
+
+
+def test_second_session_appending_to_shard_keeps_ids_unique(tmp_path,
+                                                            monkeypatch):
+    """A manual (launcher-less) resume appends a SECOND session to the
+    same shard with its counter restarting at 1 — the pid qualifier
+    keeps the collision-free contract; under the launcher the attempt
+    index plays that role instead."""
+    monkeypatch.delenv("DPT_ATTEMPT", raising=False)
+    t1 = trace_lib.tracer_for(str(tmp_path), 0, armed=True)
+    t1.instant("a", "x")
+    t1.close()
+    t2 = trace_lib.tracer_for(str(tmp_path), 0, armed=True)  # appends
+    t2.instant("b", "x")
+    t2.close()
+    monkeypatch.setenv("DPT_ATTEMPT", "3")
+    t3 = trace_lib.tracer_for(str(tmp_path), 0, armed=True)
+    t3.instant("c", "x")
+    t3.close()
+    events = trace_lib.read_trace(trace_lib.trace_path(str(tmp_path), 0))
+    sids = [e["sid"] for e in events]
+    assert len(sids) == 3 and len(set(sids)) == 3, sids
+    assert sids[0] == "rank0:1"
+    assert sids[1].startswith("rank0.p")      # pid-qualified append
+    assert sids[2].startswith("rank0.a3:")    # attempt-qualified
+
+
+def test_trace_reader_skips_torn_tail(tmp_path):
+    """A SIGKILL mid-append leaves one partial line; the reader (the
+    read_journal one-owner contract) yields the intact prefix."""
+    tr = trace_lib.tracer_for(str(tmp_path), 3, armed=True)
+    tr.instant("a", "x")
+    tr.instant("b", "x")
+    tr.close()
+    path = trace_lib.trace_path(str(tmp_path), 3)
+    with open(path, "a") as f:
+        f.write('{"ph": "X", "name": "torn mid-wri')
+    events = trace_lib.read_trace(path)
+    assert [e["name"] for e in events] == ["a", "b"]
+    # and the exporter rides the same reader: no raise, torn line absent
+    ct = export_lib.chrome_trace(str(tmp_path))
+    assert not any("torn" in e.get("name", "")
+                   for e in ct["traceEvents"])
+
+
+def test_tracing_off_path_is_free(tmp_path, monkeypatch):
+    """The off path allocates NO span objects and writes nothing: span()
+    returns one shared singleton, and any _Span construction or shard
+    write during a disabled TrainLoop step is a test failure."""
+    assert trace_lib.NULL.span("a") is trace_lib.NULL.span("b")
+    assert trace_lib.NULL.complete("x", "c", 0.0, 1.0) == ""
+    assert not trace_lib.NULL.enabled
+
+    def bomb(*a, **k):
+        raise AssertionError("tracing-off path built a span / wrote")
+
+    monkeypatch.delenv(trace_lib.TRACE_ENV, raising=False)
+    monkeypatch.setattr(trace_lib._Span, "__init__", bomb)
+    monkeypatch.setattr(trace_lib.Tracer, "_emit", bomb)
+
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.parallel import make_mesh
+    from distributed_pipeline_tpu.utils import logger
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=2, num_heads=2, dtype="float32")
+    data = load_data_from_args("train", batch_size=8,
+                               dataset="synthetic-lm", seq_len=16,
+                               vocab_size=64, seed=0)
+    loop = TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                     learning_steps=100, log_interval=10 ** 9,
+                     save_interval=10 ** 9, mesh=make_mesh(dp=8),
+                     checkpoint_dir=str(tmp_path), seed=5)
+    assert loop.tracer is trace_lib.NULL
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_step(next(loop.data))
+        loop.run_step(next(loop.data))
+        loop.save()
+    assert not os.path.exists(trace_lib.trace_path(str(tmp_path), 0))
+
+
+def test_trainloop_traced_spans_match_goodput_boundaries(tmp_path,
+                                                         monkeypatch):
+    """DPT_TRACE arms the trainer; step/save/restore/compile spans land
+    in the rank shard, and the compile span re-books the exact seconds
+    the goodput ledger got."""
+    monkeypatch.setenv(trace_lib.TRACE_ENV, "1")
+
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.parallel import make_mesh
+    from distributed_pipeline_tpu.utils import logger
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=2, num_heads=2, dtype="float32")
+    data = load_data_from_args("train", batch_size=8,
+                               dataset="synthetic-lm", seq_len=16,
+                               vocab_size=64, seed=0)
+    loop = TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                     learning_steps=2, log_interval=10 ** 9,
+                     save_interval=10 ** 9, mesh=make_mesh(dp=8),
+                     checkpoint_dir=str(tmp_path), seed=5)
+    assert loop.tracer.enabled
+    with logger.scoped_configure(format_strs=[]):
+        loop.run_loop()
+    events = trace_lib.read_trace(trace_lib.trace_path(str(tmp_path), 0))
+    by = {}
+    for e in events:
+        by.setdefault(e["name"], []).append(e)
+    assert [e["args"]["step"] for e in by["step"]] == [1, 2]
+    assert by["save"] and by["restore"]
+    compile_total = sum(e["dur"] for e in by["compile"])
+    assert compile_total == pytest.approx(loop.goodput.get("compile_s"))
+    assert sum(e["dur"] for e in by["restore"]) == pytest.approx(
+        loop.goodput.get("restore_s"))
+
+
+def test_profile_steps_window_parsing(tmp_path):
+    from distributed_pipeline_tpu.data import load_data_from_args
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.parallel import make_mesh
+    from distributed_pipeline_tpu.utils.trainer import TrainLoop
+
+    wl = create_model_from_config(
+        model_family="gpt2", vocab_size=64, seq_len=16, hidden_size=32,
+        num_layers=2, num_heads=2, dtype="float32")
+
+    def build(profile_steps):
+        data = load_data_from_args("train", batch_size=8,
+                                   dataset="synthetic-lm", seq_len=16,
+                                   vocab_size=64, seed=0)
+        return TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                         learning_steps=100, log_interval=10 ** 9,
+                         save_interval=10 ** 9, mesh=make_mesh(dp=8),
+                         checkpoint_dir="", seed=5,
+                         profile_steps=profile_steps)
+
+    assert build("")._profile_window == (3, 8)
+    assert build("5:12")._profile_window == (5, 12)
+    with pytest.raises(ValueError, match="profile_steps"):
+        build("12:5")
+    with pytest.raises(ValueError, match="profile_steps"):
+        build("nope")
+
+
+# ================================================================= export
+
+def _fake_run_dir(tmp_path):
+    d = str(tmp_path / "run")
+    os.makedirs(d, exist_ok=True)
+    tr = trace_lib.tracer_for(d, 0, armed=True)
+    t0 = time.time() - 30
+    tr.complete("step", "train", t0 + 1, 0.5, args={"step": 1})
+    tr.complete("save", "ckpt", t0 + 2, 0.2, args={"step": 1})
+    tr.close()
+    goodput.append_attempt(d, {
+        "attempt": 0, "rc": -9, "t_spawn": t0, "t_exit": t0 + 5,
+        "duration_s": 5.0, "downtime_s": 0.0, "steps": 3,
+        "hung": True, "hang_s": 2.0, "hang_kind": "stall"})
+    goodput.append_attempt(d, {
+        "attempt": 1, "rc": 0, "t_spawn": t0 + 6, "t_exit": t0 + 12,
+        "duration_s": 6.0, "downtime_s": 1.0, "steps": 5})
+    with open(goodput.beacon_path(d, 0), "w") as f:
+        json.dump({"step": 8, "t": t0 + 11.5, "attempt": 1,
+                   "goodput": {"goodput": 0.8, "wall_s": 6.0}}, f)
+    return d
+
+
+def test_chrome_trace_schema_validity(tmp_path):
+    """Every event carries the Chrome-trace required keys with sane
+    types; pids have process_name metadata; the payload JSON-serializes
+    (what Perfetto actually loads)."""
+    d = _fake_run_dir(tmp_path)
+    ct = export_lib.chrome_trace(d)
+    json.dumps(ct)  # loadable
+    events = ct["traceEvents"]
+    assert events
+    named_pids = set()
+    for e in events:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        assert e["ph"] in ("X", "i", "M")
+        if e["ph"] == "M":
+            if e["name"] == "process_name":
+                named_pids.add(e["pid"])
+            continue
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    data_pids = {e["pid"] for e in events if e["ph"] != "M"}
+    assert data_pids and data_pids <= named_pids
+    names = {e["name"] for e in events}
+    # untraced artifacts export too: attempts + watchdog + beacon ride in
+    assert {"attempt 0", "attempt 1", "downtime", "watchdog_kill",
+            "last_beacon", "step", "save"} <= names
+
+
+def test_prometheus_snapshot_run_dir(tmp_path):
+    d = _fake_run_dir(tmp_path)
+    lines = export_lib.prometheus_lines(d, now=time.time())
+    text = "\n".join(lines)
+    assert 'dpt_beacon_step{rank="0"} 8' in text
+    assert "dpt_attempts_total 2" in text
+    assert 'dpt_goodput_seconds{category="hang"} 2' in text
+    # textfile format: every sample line is `name{labels} value`
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+        assert name[0].isalpha()
+
+
+def test_status_cli_run_dir_and_export(tmp_path, capsys):
+    d = _fake_run_dir(tmp_path)
+    snap = status_lib.main([d])
+    out = capsys.readouterr().out
+    assert snap["kind"] == "run" and snap["attempts"] == 2
+    assert "rank" in out and "goodput" in out
+    # --export writes the Perfetto JSON via obs.export
+    out_path = str(tmp_path / "t.json")
+    prom_path = str(tmp_path / "m.prom")
+    summary = status_lib.main([d, "--export", out_path,
+                               "--prom", prom_path])
+    assert summary["events"] > 0
+    with open(out_path) as f:
+        assert json.load(f)["traceEvents"]
+    assert os.path.getsize(prom_path) > 0
+
+
+def test_export_cli_main(tmp_path, capsys):
+    d = _fake_run_dir(tmp_path)
+    summary = export_lib.main([d])
+    assert os.path.exists(os.path.join(d, "trace.json"))
+    assert summary["kind"] == "run" and summary["events"] > 0
+    assert json.loads(capsys.readouterr().out.strip())["events"] \
+        == summary["events"]
+
+
+# ====================================================== fleet e2e (traced)
+
+def _fake_ckpt(base, step, salt):
+    d = os.path.join(str(base), f"model_{step:06d}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "_CHECKPOINT_METADATA"), "w") as f:
+        f.write("{}")
+    with open(os.path.join(d, "params.json"), "w") as f:
+        json.dump({"step": step, "salt": salt}, f)
+    return d
+
+
+@pytest.mark.chaos
+def test_traced_fleet_kill_and_swap_export_one_timeline(tmp_path,
+                                                        monkeypatch):
+    """The acceptance e2e: a kill_replica fleet run under DPT_TRACE plus
+    one hot-swap exports as ONE timeline in which (a) the injected kill
+    is visible (nonzero-rc attempt span + respawn on the victim's pid),
+    (b) the replayed request's serve span runs on a SIBLING replica
+    under the SAME trace id the router journaled, and (c) the hot-swap
+    drain/load windows appear on every replica."""
+    monkeypatch.setenv(trace_lib.TRACE_ENV, "1")
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=3)
+    _fake_ckpt(ckpt, 2, salt=9)
+    plan = {"faults": [{"kind": "kill_replica", "step": 1, "rank": 1,
+                        "sig": "SIGKILL"}]}
+    monkeypatch.setenv(CHAOS_PLAN_ENV, json.dumps(plan))
+    fleet_dir = str(tmp_path / "fleet")
+    fleet = ServingFleet(
+        fleet_dir, 3, "tests._fleet_child",
+        ["--checkpoint_dir", str(ckpt), "--step", "1",
+         "--token_interval_s", "0.01"],
+        max_restarts=3, restart_backoff_s=0.1, restart_backoff_max_s=0.5,
+        monitor_interval=0.02)
+    fleet.start()
+    router = Router(fleet.clients(),
+                    goodput.serving_journal_path(fleet_dir))
+    swap_report = {}
+    try:
+        deadline = time.time() + 20
+        while len(fleet.ready_replicas()) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(fleet.ready_replicas()) == 3, "fleet never came up"
+        for i in range(9):
+            router.submit(np.arange(i + 1, i + 5, dtype=np.int32), 12)
+        swap_armed = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            router.poll()
+            if not swap_armed and router.completed >= 3:
+                swap_armed = True
+                fleet.begin_hot_swap(str(ckpt), step=2,
+                                     drain_timeout_s=20,
+                                     swap_timeout_s=20)
+            if fleet.swap_active:
+                rep = fleet.step_swap(router)
+                if rep is not None:
+                    swap_report.update(rep)
+            if (router.all_done() and not fleet.swap_active
+                    and swap_armed and swap_report):
+                break
+            time.sleep(0.02)
+    finally:
+        fleet.stop()
+    assert router.completed == 9 and router.replayed >= 1
+    assert swap_report.get("ok") is True, swap_report
+
+    ct = export_lib.chrome_trace(fleet_dir)
+    json.dumps(ct)
+    events = [e for e in ct["traceEvents"] if e["ph"] != "M"]
+    pid_name = {e["pid"]: e["args"]["name"]
+                for e in ct["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"}
+    victim_pid = next(p for p, n in pid_name.items() if n == "replica_1")
+    router_pid = next(p for p, n in pid_name.items() if n == "router")
+
+    # (a) the kill: the victim's timeline shows a nonzero-rc attempt
+    # span AND a later respawned attempt
+    victim_attempts = [e for e in events if e["pid"] == victim_pid
+                       and e["cat"] == "supervise"
+                       and e["name"].startswith("attempt")]
+    assert len(victim_attempts) >= 2
+    assert any(e["args"].get("rc") not in (0, None)
+               for e in victim_attempts)
+
+    # (b) one shared trace id per request, replayed onto a live worker:
+    # the replayed request's journal spans (router pid) and its serve
+    # span (worker pid) carry the SAME id. The serving replica is
+    # normally a sibling; a RESPAWNED victim is also a legal health-
+    # gated target (on a slow box the respawn can beat the router's
+    # replay poll), so the pin is "a worker span exists and matches the
+    # replica the router journaled the completion on", not "never the
+    # victim's pid".
+    replayed = next(r for r in router.records.values() if r.replays > 0)
+    tid = replayed.trace_id
+    tid_events = [e for e in events
+                  if e.get("args", {}).get("trace_id") == tid]
+    assert any(e["pid"] == router_pid and e["name"] == "replayed_work"
+               for e in tid_events)
+    serve_spans = [e for e in tid_events if e["name"] == "serve"]
+    assert serve_spans, "worker serve span missing for replayed request"
+    assert all(e["pid"] != router_pid for e in serve_spans)
+    assert {e["args"]["replica"] for e in serve_spans} \
+        == {replayed.replica}
+
+    # (c) hot-swap drain + load windows on every replica's swap track,
+    # and a post-swap ready instant at the new params version
+    for rid in range(3):
+        pid = next(p for p, n in pid_name.items()
+                   if n == f"replica_{rid}")
+        names = {e["name"] for e in events
+                 if e["pid"] == pid and e["cat"] == "swap"}
+        assert {"drain", "swap"} <= names, (rid, names)
+    assert any(e["name"] == "ready"
+               and e["args"].get("params_step") == 2 for e in events)
+
+    # span ids stay unique across the MERGED fleet timeline: the worker
+    # labels are replica-qualified (r1.rank0) and attempt-qualified
+    # (.aN), so neither N replicas writing their own trace_rank0.jsonl
+    # nor a respawned attempt appending to the victim's shard collide
+    sids = [e["args"]["span_id"] for e in events
+            if "span_id" in e.get("args", {})]
+    assert sids and len(sids) == len(set(sids))
+
+    # the ledger still accounts every replica-second with tracing on
+    agg = goodput.aggregate_serving(fleet_dir)
+    assert agg["accounted_frac"] == pytest.approx(1.0, abs=0.05)
+
+    # live telemetry over the same dir: per-replica serving snapshot in
+    # the Prometheus textfile + the status table's fleet view
+    prom = "\n".join(export_lib.prometheus_lines(fleet_dir))
+    assert "dpt_replica_serving_seconds" in prom
+    assert 'dpt_requests_total{state="replayed"}' in prom
+    snap = status_lib.fleet_status(fleet_dir)
+    assert snap["completed"] == 9 and snap["replayed"] >= 1
+    assert snap["ttft_p95_s"] is not None
+    assert {r["params_step"] for r in snap["replicas"]} == {2}
