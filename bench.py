@@ -56,6 +56,14 @@ child process with a >= 2-way data axis (run/zero1_ab.py); the
 ``zero1-ab-delta`` row reports steps/s parity plus the ~dp x per-replica
 optimizer-bytes drop.
 
+Auto-tuner leg (ISSUE 13): ``diffuseq-base-seq128-tune`` runs a
+screen-only budgeted layout search (rule tables x mesh splits, tune/) on
+the forced-host dp=2 CPU mesh and passes only if the tuner reproduces or
+beats the hand-tuned table's steps/s within the +-3% band with every
+enumerated candidate accounted (completed + pruned + rejected + skipped
+== enumerated). Child spawn/env/timeout folding for BOTH child legs is
+owned by tune/measure.py.
+
 ``BENCH_ONLY`` selects legs by EXACT name, or by glob when it contains a
 wildcard (``diffuseq-base-seq128*`` = the old substring behavior).
 
@@ -1173,19 +1181,19 @@ def main() -> None:
         ``size`` selects the preset — the xl leg (ISSUE 10 satellite)
         runs the SAME protocol at the xl shape the ZeRO-1 headroom
         exists for; a child that dies (HBM OOM at xl with two live
-        loops) comes back as an error row, never an abort."""
-        import subprocess
+        loops) comes back as an error row, never an abort.
 
-        env = dict(os.environ)
+        Spawn/env-pinning/timeout-folding is the tuner's shared
+        child-measurement scaffold (tune/measure.py — one owner, ISSUE
+        13 satellite); only the ZeRO flag set and CPU dims live here."""
+        from distributed_pipeline_tpu.tune import measure as tune_measure
+
         args = ["--family", "diffuseq", "--size", size,
                 "--batch", str(batch), "--microbatch", str(microbatch),
                 "--seq_len", str(seq_len), "--dtype", dtype,
                 "--window_steps", str(window_steps),
                 "--rounds", str(rounds)]
         if not on_tpu:
-            env.update({"JAX_PLATFORMS": "cpu",
-                        "XLA_FLAGS":
-                            "--xla_force_host_platform_device_count=2"})
             # Wider than the usual CPU smoke dims (hidden 256 vs 64): the
             # per-step weight-update all-gather is a fixed ~per-leaf op
             # cost on CPU, so the step must carry enough matmul for the
@@ -1197,24 +1205,97 @@ def main() -> None:
             args += ["--hidden", str(cpu_hidden),
                      "--layers", str(cpu_layers), "--heads", "4",
                      "--vocab", "256"]
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-m",
-                 "distributed_pipeline_tpu.run.zero1_ab"] + args,
-                env=env, capture_output=True, text=True, timeout=timeout_s,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
-            return {"name": name,
-                    "error": f"zero1 A/B child exceeded its "
-                             f"{timeout_s:.0f}s timeout"}
-        lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
-        if proc.returncode != 0 or not lines:
-            tail = (proc.stderr or proc.stdout or "")[-300:]
-            return {"name": name,
-                    "error": f"zero1 A/B child rc={proc.returncode}: {tail}"}
-        row = json.loads(lines[-1])
+        row = tune_measure.run_child(
+            "distributed_pipeline_tpu.run.zero1_ab", args,
+            env=tune_measure.child_env(None if on_tpu else 2),
+            timeout_s=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            tag="zero1 A/B child")
         row["name"] = name
         return row
+
+    def measure_tune(name: str, *, budget_s: float = 150.0,
+                     timeout_s: float = 215.0, screen_steps: int = 5,
+                     noise_band_pct: float = 3.0):
+        """Auto-tuner acceptance leg (ISSUE 13): a SCREEN-ONLY budgeted
+        layout search for the headline family on the forced-host dp=2
+        CPU mesh — always the CPU tuner stack, like every robustness
+        leg: it measures the control loop, not the chip. Acceptance:
+        the tuner must REPRODUCE OR BEAT the hand-tuned family table's
+        steps/s (the baseline candidate, measured first) within the
+        box's +-3% noise band, account for every enumerated candidate
+        (rejected + measured + pruned + skipped == enumerated), and the
+        winner's steady recompile count must be 0."""
+        import shutil
+
+        from distributed_pipeline_tpu.tune import measure as tune_measure
+
+        out_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", "tune_run"))
+        shutil.rmtree(out_dir, ignore_errors=True)
+        args = ["--family", "diffuseq", "--n_devices", "2",
+                "--screen_only", "true", "--budget_s", str(budget_s),
+                "--batch_size", "8", "--microbatch", "8",
+                "--seq_len", "128", "--vocab_size", "256",
+                "--hidden_size", "64", "--num_layers", "2",
+                "--num_heads", "4", "--dtype", "float32",
+                "--screen_steps", str(screen_steps),
+                "--child_timeout_s", "90",
+                "--out_dir", out_dir]
+        row = tune_measure.run_child(
+            "distributed_pipeline_tpu.run.tune", args,
+            # the tune PARENT runs on 2 forced CPU host devices too (its
+            # candidate validation is arithmetic; children re-force)
+            env=tune_measure.child_env(2), timeout_s=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            tag="tune leg")
+        if "error" in row:
+            return {"name": name, "error": row["error"]}
+        fam = (row.get("families") or {}).get("diffuseq") or {}
+        counts = fam.get("counts") or {}
+        winner = fam.get("winner") or {}
+        base_sps = fam.get("baseline_steps_per_s")
+        win_sps = winner.get("steps_per_s")
+        failures = []
+        if fam.get("accounted") != counts.get("enumerated"):
+            failures.append(
+                f"trial accounting broken: {fam.get('accounted')} "
+                f"accounted != {counts.get('enumerated')} enumerated")
+        if not base_sps:
+            failures.append("hand-tuned baseline candidate not measured")
+        if not win_sps:
+            failures.append("no winner measured")
+        ratio = (win_sps / base_sps) if base_sps and win_sps else 0.0
+        if base_sps and win_sps and \
+                ratio < 1.0 - noise_band_pct / 100.0:
+            failures.append(
+                f"tuner lost to the hand-tuned table: winner "
+                f"{win_sps} vs baseline {base_sps} steps/s "
+                f"({100 * (ratio - 1):+.1f}%, band +-{noise_band_pct}%)")
+        if winner and winner.get("steady_recompile_count") not in (0, None):
+            failures.append(
+                f"winner recompiled in steady state "
+                f"({winner.get('steady_recompile_count')})")
+        if failures:
+            return {"name": name, "error": "; ".join(failures)[:500]}
+        return {
+            "name": name,
+            "winner": winner.get("cid"),
+            "winner_mesh": winner.get("mesh"),
+            "winner_zero1": winner.get("shard_optimizer"),
+            "winner_steps_per_s": win_sps,
+            "baseline_steps_per_s": base_sps,
+            "winner_vs_baseline": round(ratio, 4),
+            "noise_band_pct": noise_band_pct,
+            "enumerated": counts.get("enumerated"),
+            "measured": counts.get("measured"),
+            "rejected": counts.get("rejected"),
+            "pruned": counts.get("pruned"),
+            "skipped": counts.get("skipped"),
+            "steady_recompile_count": winner.get("steady_recompile_count"),
+            "tune_elapsed_s": row.get("elapsed_s"),
+            "n_devices": row.get("n_devices"),
+        }
 
     # Per-chip batch sizes are the measured MFU sweet spots on v5e (base:
     # 64/128/256/512 sweep in r2; large/gpt2 sized to fit one chip's HBM
@@ -1337,6 +1418,14 @@ def main() -> None:
             measure_elastic, "diffuseq-base-seq128-elastic",
             steps=3000, save_interval=250, stall_step_at=1400,
             hang_timeout_s=2.0, batch=16)),
+        # Auto-tuner leg (ISSUE 13): screen-only budgeted layout search
+        # on the forced-host dp=2 CPU mesh — the tuner must reproduce or
+        # beat the hand-tuned family table within the +-3% noise band,
+        # journal every trial (accounting closed), and land a winner
+        # with steady recompiles 0. Always the CPU tuner stack: the leg
+        # measures the control loop, not the chip.
+        ("diffuseq-base-seq128-tune", functools.partial(
+            measure_tune, "diffuseq-base-seq128-tune")),
         # Serving-fleet resilience leg (ISSUE 11): 3 replicas under
         # sustained Poisson load, one kill_replica mid-request + one
         # checkpoint hot-swap; acceptance is p50/p95 TTFT SLOs under

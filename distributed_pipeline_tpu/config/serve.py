@@ -119,6 +119,14 @@ class ServeSettings(S):
                                   "worker against this replica dir "
                                   "(set by the fleet supervisor)")
     replica_id: int = _(-1, "INTERNAL: this worker's replica index")
+    replica_platform: str = _(
+        "auto", "jax backend the replica workers pin (ISSUE 13 "
+                "satellite): 'auto' inherits the PARENT's platform "
+                "(JAX_PLATFORMS in the fleet parent's environment — cpu "
+                "under the test/dev rings, unset on a TPU host so "
+                "replicas see the real chips); 'cpu' forces the dev-ring "
+                "behavior (fake devices, remote plugin disabled); any "
+                "other value pins that platform; '' = never pin")
     hang_timeout_s: float = _(10.0, "per-replica hang watchdog: a replica "
                                     "whose beacons freeze this long is "
                                     "SIGKILLed and its in-flight requests "

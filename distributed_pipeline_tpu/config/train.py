@@ -193,7 +193,26 @@ class MeshSettings(S):
             "path — an ordered list of [path-regex, spec] pairs, spec a "
             "list of mesh-axis names / null / nested list (several axes "
             "on one dim), ending with an explicit catch-all ['.*', []]; "
-            "empty = the model family's built-in table")
+            "a TUNER ARTIFACT (run/tune.py) is accepted verbatim — its "
+            "rules always apply, and its mesh/ZeRO recommendations apply "
+            "when the mesh flags are still at their defaults; empty = "
+            "the model family's built-in table")
+    auto_tune: bool = _(
+        False, "run the sharding auto-tuner's SCREEN inline before "
+               "training (tune/): rank 0 measures candidate rule tables "
+               "x mesh splits for this exact model/shape/device set in "
+               "child processes under --auto_tune_budget_s, writes the "
+               "winner to <run_dir>/tune_artifact.json, and the run "
+               "consumes it like --partition_rules (mesh/ZeRO "
+               "recommendations apply only when those flags are still "
+               "at their defaults); a restart attempt reuses the "
+               "existing artifact instead of re-tuning; ignored when "
+               "--partition_rules is set explicitly")
+    auto_tune_budget_s: float = _(
+        60.0, "wall-clock budget for the inline --auto_tune screen "
+              "(candidates it cannot afford are skipped; the baseline "
+              "table is measured first so a tiny budget degrades to "
+              "the hand-tuned layout)")
 
 
 class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):

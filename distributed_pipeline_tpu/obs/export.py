@@ -128,6 +128,40 @@ def _attempt_events(run_dir: str) -> List[dict]:
     return events
 
 
+def _tune_trial_events(d: str) -> List[dict]:
+    """``tune_trials.jsonl`` -> per-trial spans (ISSUE 13): the tuner's
+    journal is its trace — every measured/pruned trial carries its wall
+    stamp and duration, so an UNTRACED tune still exports a timeline
+    (the attempts.jsonl pattern; an armed tune tracer books richer spans
+    itself and wins — see the caller's shard check). The journal stamps
+    ``t`` at trial END, so the span starts at ``t - dur_s``; static
+    rejects (no duration) land as instants."""
+    events: List[dict] = []
+    for row in read_trace(os.path.join(d, "tune_trials.jsonl")):
+        if not isinstance(row, dict) or row.get("kind") not in ("trial",
+                                                                "final"):
+            continue
+        t = _fnum(row.get("t"))
+        if t <= 0:
+            continue
+        dur = _fnum(row.get("dur_s"))
+        args = {"cid": row.get("cid"), "rung": row.get("rung"),
+                "status": row.get("status")}
+        res = row.get("result")
+        if isinstance(res, dict) and res.get("steps_per_s") is not None:
+            args["steps_per_s"] = res.get("steps_per_s")
+        if row.get("reason"):
+            args["reason"] = row.get("reason")
+        name = f"{row.get('kind')} {row.get('cid')}"
+        if dur > 0:
+            events.append({"ph": "X", "name": name, "cat": "tune",
+                           "t": t - dur, "dur": dur, "args": args})
+        else:
+            events.append({"ph": "i", "name": name, "cat": "tune",
+                           "t": t, "args": args})
+    return events
+
+
 def _beacon_events(run_dir: str) -> Dict[int, dict]:
     """rank -> one ``beacon`` instant at the rank's LAST beacon time (a
     killed attempt's flight-recorder position on the timeline)."""
@@ -289,6 +323,7 @@ def collect_sources(d: str) -> List[Tuple[int, str, List[dict]]]:
     rank_shards: Dict[int, List[dict]] = {}
     launcher_events: List[dict] = []
     have_launcher_shard = False
+    have_tune_shard = False
     for label, events in _shard_events(d):
         m = re.fullmatch(r"rank(\d+)", label)
         if m:
@@ -296,9 +331,14 @@ def collect_sources(d: str) -> List[Tuple[int, str, List[dict]]]:
         else:
             have_launcher_shard = (have_launcher_shard
                                    or label.startswith("launcher"))
+            have_tune_shard = have_tune_shard or label.startswith("tune")
             launcher_events.extend(events)
     if not have_launcher_shard:
         launcher_events.extend(_attempt_events(d))
+    if not have_tune_shard:
+        # untraced tune runs: the trial journal is the span source (the
+        # attempts.jsonl pattern; an armed tune tracer wins)
+        launcher_events.extend(_tune_trial_events(d))
     beacons = _beacon_events(d)
     for rank, ev in beacons.items():
         rank_shards.setdefault(rank, []).append(ev)

@@ -184,12 +184,23 @@ def _tee_pump(proc, sink, prefix: str):
 def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
                 run_timestamp: Optional[str] = None,
                 cache_dir: str = "",
-                extra_env: Optional[dict] = None) -> dict:
+                extra_env: Optional[dict] = None,
+                platform: str = "cpu") -> dict:
     """Environment for spawned worker ``i`` — the ring coordinates plus the
     persistent-compilation-cache propagation: every worker (and every
     restart attempt) points at the SAME cache dir, so only the first ring
     member to reach a given computation pays its XLA compile; siblings and
-    respawned attempts hit the on-disk cache."""
+    respawned attempts hit the on-disk cache.
+
+    ``platform`` pins the worker's jax backend. The default ("cpu") is
+    the dev-ring contract this launcher has always had; the serving fleet
+    passes the parent's platform through so TPU replicas are possible
+    (ISSUE 13 satellite — the old unconditional cpu pin made fleet
+    replicas CPU-only forever). Empty string = no pin at all: the worker
+    inherits whatever platform selection the caller's environment
+    carries. The fake-device forcing and the remote-plugin disable only
+    apply to cpu-pinned workers — they exist to protect dev rings, not to
+    cripple real hardware."""
     env = dict(os.environ)
     if run_timestamp:
         env["DPT_RUN_TIMESTAMP"] = run_timestamp
@@ -206,16 +217,20 @@ def _worker_env(i: int, nprocs: int, coord: str, devices_per_proc: int,
         "JAX_COORDINATOR_ADDRESS": coord,
         "JAX_NUM_PROCESSES": str(nprocs),
         "JAX_PROCESS_INDEX": str(i),
-        "JAX_PLATFORMS": "cpu",
-        # Disable any site-installed remote-accelerator plugin for
-        # dev-mode CPU workers (a registered plugin may override the
-        # platform selection and grab single-tenant hardware).
-        "PALLAS_AXON_POOL_IPS": "",
-        "XLA_FLAGS": (env_flags := env.get("XLA_FLAGS", ""))
-        + (" " if env_flags else "")
-        + f"--xla_force_host_platform_device_count="
-          f"{devices_per_proc}",
     })
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if platform == "cpu":
+        env.update({
+            # Disable any site-installed remote-accelerator plugin for
+            # dev-mode CPU workers (a registered plugin may override the
+            # platform selection and grab single-tenant hardware).
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": (env_flags := env.get("XLA_FLAGS", ""))
+            + (" " if env_flags else "")
+            + f"--xla_force_host_platform_device_count="
+              f"{devices_per_proc}",
+        })
     # Supervision channel (restart accounting): DPT_ATTEMPT / DPT_SPAWN_T /
     # DPT_RUN_DIR_FILE ride here — launcher-owned keys win over anything
     # inherited from the caller's environ.
@@ -286,7 +301,7 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
                      hang_startup_timeout_s: float = 0.0,
                      run_dir_file: str = "",
                      status: Optional[dict] = None,
-                     tag: str = "") -> int:
+                     tag: str = "", platform: str = "cpu") -> int:
     """One attempt: spawn the ring, poll liveness, fail fast on any death.
 
     A worker that dies (e.g. on an import error before joining the ring)
@@ -331,7 +346,8 @@ def _run_worker_ring(cmd_base: List[str], nprocs: int, devices_per_proc: int,
     try:
         for i in range(nprocs):
             env = _worker_env(i, nprocs, coord, devices_per_proc,
-                              run_timestamp, cache_dir, extra_env=extra_env)
+                              run_timestamp, cache_dir, extra_env=extra_env,
+                              platform=platform)
             if log_dir:
                 # append: a restarted ring continues the same files (the
                 # attempt boundary is visible from the launcher's own log)
@@ -597,7 +613,8 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                             hang_timeout_s: float = 0.0,
                             hang_startup_timeout_s: float = 0.0,
                             extra_env: Optional[dict] = None,
-                            tag: str = "") -> int:
+                            tag: str = "",
+                            worker_platform: str = "cpu") -> int:
     """Spawn ``nprocs`` local worker processes forming a jax.distributed ring
     over loopback (dev-mode multi-process, one CPU backend per worker).
 
@@ -632,6 +649,9 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
 
     ``extra_env`` reaches every worker of every attempt (launcher-owned
     keys — DPT_ATTEMPT, ring coordinates, DPT_RUN_DIR_FILE — always win);
+    ``worker_platform`` pins the workers' jax backend ("cpu", the
+    historical dev-ring default; "" = inherit the environment — how the
+    serving fleet runs TPU replicas, see :func:`_worker_env`);
     ``tag`` prefixes this supervisor's log lines, so N rings supervised
     concurrently from one process (the serving fleet runs one per
     replica, in threads) stay attributable. This function is
@@ -704,7 +724,7 @@ def run_argv_as_distributed(modname: str, script_argv: Sequence[str],
                 hang_timeout_s=hang_timeout_s,
                 hang_startup_timeout_s=hang_startup_timeout_s,
                 run_dir_file=run_dir_file,
-                status=ring_status, tag=tag)
+                status=ring_status, tag=tag, platform=worker_platform)
             t_exit = time.time()
             record, run_dir = _harvest_attempt(
                 run_dir_file, attempt, code, t_spawn, t_exit, prev_t_exit,

@@ -56,6 +56,7 @@ __all__ = [
     "Rules", "match_partition_rules", "named_tree_map", "tree_path_name",
     "fix_spec", "resolve_shardings", "make_shard_and_gather_fns",
     "zero1_spec", "zero1_shardings", "parse_partition_rules",
+    "rules_from_json", "rules_to_json", "load_partition_artifact",
     "rules_for_workload", "MOE_RULES", "DIFFUSEQ_RULES", "GPT2_RULES",
 ]
 
@@ -314,23 +315,11 @@ def rules_for_workload(workload: Any) -> Optional[Rules]:
     return _FAMILY_RULES.get(getattr(workload, "family", ""))
 
 
-def parse_partition_rules(text: str) -> Optional[Rules]:
-    """``--partition_rules`` parser: inline JSON, ``@/path.json``, or a
-    bare file path. The JSON is an ordered list of ``[regex, spec]`` pairs
-    where ``spec`` is a list of entries — ``null`` (replicate the dim), a
-    mesh-axis name, or a list of axis names (several axes on one dim),
-    e.g. ``[["attn/qkv$", ["fsdp", null, "tensor", null]], [".*", []]]``.
-    Returns None for empty input."""
-    if not text:
-        return None
-    body = text.strip()
-    if body.startswith("@"):
-        with open(body[1:]) as f:
-            body = f.read()
-    elif not body.startswith("["):
-        with open(body) as f:
-            body = f.read()
-    raw = json.loads(body)
+def rules_from_json(raw: Any) -> Rules:
+    """Wire-format rule list -> Rules: an ordered list of
+    ``[regex, spec]`` pairs where ``spec`` is a list of entries — ``null``
+    (replicate the dim), a mesh-axis name, or a list of axis names
+    (several axes on one dim)."""
     rules = []
     for entry in raw:
         if not (isinstance(entry, list) and len(entry) == 2
@@ -342,3 +331,71 @@ def parse_partition_rules(text: str) -> Optional[Rules]:
         rules.append((pat, P(*(tuple(e) if isinstance(e, list) else e
                                for e in spec))))
     return tuple(rules)
+
+
+def rules_to_json(rules: Rules) -> list:
+    """Rules -> the wire format :func:`rules_from_json` reads (the tuner
+    artifact writer; round-trips exactly)."""
+    out = []
+    for pat, spec in rules:
+        out.append([pat, [list(e) if isinstance(e, tuple) else e
+                          for e in tuple(spec)]])
+    return out
+
+
+def _read_rules_body(text: str) -> str:
+    """Shared ``--partition_rules`` input resolution: inline JSON,
+    ``@/path.json``, or a bare file path."""
+    body = text.strip()
+    if body.startswith("@"):
+        with open(body[1:]) as f:
+            return f.read()
+    if not body.startswith(("[", "{")):
+        with open(body) as f:
+            return f.read()
+    return body
+
+
+def parse_partition_rules(text: str) -> Optional[Rules]:
+    """``--partition_rules`` parser: inline JSON, ``@/path.json``, or a
+    bare file path. The JSON is either the ordered ``[regex, spec]`` pair
+    list (:func:`rules_from_json`), e.g.
+    ``[["attn/qkv$", ["fsdp", null, "tensor", null]], [".*", []]]``, or a
+    TUNER ARTIFACT object (tune/search.py) whose rules ride the
+    ``partition_rules`` key — so the file the auto-tuner emits is loaded
+    verbatim. Returns None for empty input."""
+    if not text:
+        return None
+    raw = json.loads(_read_rules_body(text))
+    if isinstance(raw, dict):
+        if "partition_rules" not in raw:
+            raise ValueError(
+                "a --partition_rules JSON object must carry the rule "
+                "list under 'partition_rules' (the tuner artifact shape)")
+        raw = raw["partition_rules"]
+    return rules_from_json(raw)
+
+
+def load_partition_artifact(text: str) -> Optional[Dict[str, Any]]:
+    """Full ``--partition_rules`` payload including the tuner's layout
+    recommendations: ``{"rules": Rules, "mesh": dict|None,
+    "shard_optimizer": bool|None}``. A plain rule list (the pre-tuner
+    input shape) yields mesh/shard_optimizer None; empty input None."""
+    if not text:
+        return None
+    raw = json.loads(_read_rules_body(text))
+    if isinstance(raw, dict):
+        if "partition_rules" not in raw:
+            raise ValueError(
+                "a --partition_rules JSON object must carry the rule "
+                "list under 'partition_rules' (the tuner artifact shape)")
+        mesh = raw.get("mesh")
+        return {
+            "rules": rules_from_json(raw["partition_rules"]),
+            "mesh": dict(mesh) if isinstance(mesh, dict) else None,
+            "shard_optimizer": (bool(raw["shard_optimizer"])
+                                if raw.get("shard_optimizer") is not None
+                                else None),
+        }
+    return {"rules": rules_from_json(raw), "mesh": None,
+            "shard_optimizer": None}

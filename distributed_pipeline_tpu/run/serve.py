@@ -488,13 +488,22 @@ def _fleet_main(settings: ServeSettings) -> dict:
         value = getattr(settings, name)
         argv += [f"--{name}", str(value)]
 
+    # Replica backend: 'auto' = the parent's own platform selection
+    # (JAX_PLATFORMS in this jax-free parent's env — "cpu" under every
+    # test/dev/bench ring, unset on a real TPU host so replicas get the
+    # chips). The old launcher behavior pinned cpu UNCONDITIONALLY,
+    # which made TPU fleet replicas impossible (r13 NOTE).
+    platform = settings.replica_platform
+    if platform == "auto":
+        platform = os.environ.get("JAX_PLATFORMS", "")
     fleet = ServingFleet(
         fleet_dir, settings.replicas,
         "distributed_pipeline_tpu.run.serve", argv,
         devices_per_proc=1,
         hang_timeout_s=settings.hang_timeout_s,
         max_restarts=settings.fleet_max_restarts,
-        restart_backoff_s=settings.fleet_backoff_s)
+        restart_backoff_s=settings.fleet_backoff_s,
+        replica_platform=platform)
     fleet.start()
     router = Router(fleet.clients(), goodput.serving_journal_path(fleet_dir),
                     stale_beacon_s=settings.stale_beacon_s)

@@ -38,6 +38,117 @@ def resolve_run_dir(args: TrainSettings) -> str:
         f"Run_{args.dataset}_lr{args.lr}_seed{args.seed}_{ts}")
 
 
+def mesh_flags_default(args) -> bool:
+    """Whether the user left every mesh-axis flag at its default — the
+    gate for applying a tuner artifact's mesh recommendation. An explicit
+    --dp/--fsdp/... is an instruction; the recommendation then only logs."""
+    return (args.dp == -1 and args.fsdp == 1 and args.sequence == 1
+            and args.tensor == 1 and args.expert == 1 and args.pipe == 1)
+
+
+def apply_tuned_layout(args, artifact, n_devices: int, n_hosts: int = 1):
+    """Fold a tuner artifact (``--partition_rules`` dict form or the
+    inline --auto_tune screen) into the settings: the RULES always apply;
+    the mesh recommendation applies only when the user left the mesh
+    flags at defaults AND it fits the live run — device count and the
+    run's own global microbatch divisibility (an artifact tuned for
+    another box or batch size must not break this one); ZeRO-1 is
+    device-count-independent and follows only the default-gate. Returns
+    the (possibly copied) args."""
+    from ..utils import logger
+
+    if artifact is None:
+        return args
+    mesh_rec = artifact.get("mesh")
+    updates = {}
+    if mesh_rec:
+        sizes = {a: int(mesh_rec.get(a, 1)) for a in
+                 ("data", "fsdp", "sequence", "tensor", "expert", "pipe")}
+        product = 1
+        for v in sizes.values():
+            product *= v
+        # the TrainLoop constructor's own divisibility contract, checked
+        # here so a refusal degrades to the default layout instead of
+        # crashing the run after model build
+        micro = args.microbatch if args.microbatch > 0 else args.batch_size
+        dpf = sizes["data"] * sizes["fsdp"] * sizes["expert"]
+        if not mesh_flags_default(args):
+            logger.info(f"tuned mesh recommendation {mesh_rec} NOT applied "
+                        f"(mesh flags set explicitly)")
+        elif product != n_devices:
+            logger.warn(f"tuned mesh recommendation {mesh_rec} NOT applied "
+                        f"(product {product} != {n_devices} devices — "
+                        f"artifact tuned for another device set)")
+        elif (micro * max(n_hosts, 1)) % dpf:
+            logger.warn(f"tuned mesh recommendation {mesh_rec} NOT applied "
+                        f"(global microbatch {micro * max(n_hosts, 1)} not "
+                        f"divisible by data x fsdp x expert = {dpf} — "
+                        f"artifact tuned at a different batch shape)")
+        else:
+            updates.update(dp=sizes["data"], fsdp=sizes["fsdp"],
+                           sequence=sizes["sequence"],
+                           tensor=sizes["tensor"], expert=sizes["expert"],
+                           pipe=sizes["pipe"])
+            logger.info(f"applying tuned mesh recommendation: {mesh_rec}")
+    zero = artifact.get("shard_optimizer")
+    if zero is not None and not args.shard_optimizer and zero:
+        updates["shard_optimizer"] = True
+        logger.info("applying tuned ZeRO-1 recommendation "
+                    "(--shard_optimizer true)")
+    return args.model_copy(update=updates) if updates else args
+
+
+def run_inline_auto_tune(args, ckpt_path: str, rank: int):
+    """--auto_tune: rank 0 runs the tuner's SCREEN for this exact
+    model/shape on the live device count and writes
+    ``<run_dir>/tune_artifact.json``; every rank then loads the artifact
+    (barrier in between, so workers never race the write). A restart
+    attempt finds the artifact already present and skips the tune —
+    re-measuring on every respawn would burn the restart budget on
+    telemetry. Returns the loaded artifact dict or None (tune failed:
+    the run proceeds on the hand-tuned defaults, loudly)."""
+    import jax
+
+    from ..obs import trace as trace_lib
+    from ..parallel import dist
+    from ..parallel.partition import load_partition_artifact
+    from ..utils import logger
+
+    path = os.path.join(ckpt_path, "tune_artifact.json")
+    if rank == 0 and not os.path.exists(path):
+        from .tune import screen_for_workload
+        tracer = trace_lib.tracer_for(ckpt_path, "tune")
+        try:
+            summary = screen_for_workload(
+                model_kwargs=dict(
+                    model_family=args.model_family,
+                    model_size=args.model_size, seq_len=args.seq_len,
+                    vocab_size=args.vocab_size,
+                    hidden_size=args.hidden_size,
+                    num_layers=args.num_layers, num_heads=args.num_heads,
+                    dtype=args.dtype),
+                batch_size=args.batch_size, microbatch=args.microbatch,
+                n_devices=jax.device_count(),
+                journal_path=os.path.join(ckpt_path, "tune_trials.jsonl"),
+                budget_s=args.auto_tune_budget_s,
+                artifact_path=path, screen_only=True,
+                seed=args.seed, tracer=tracer,
+                echo=lambda s: logger.info(s))
+            if not summary.get("winner"):
+                logger.warn(f"auto-tune produced no measured candidate "
+                            f"({summary.get('error')}); training on the "
+                            f"hand-tuned defaults")
+        except Exception as e:
+            logger.warn(f"auto-tune failed ({type(e).__name__}: {e}); "
+                        f"training on the hand-tuned defaults")
+        finally:
+            tracer.close()
+    dist.barrier("auto_tune")
+    if os.path.exists(path):
+        return load_partition_artifact(path)
+    return None
+
+
 def build_mesh(args, *, elastic: bool):
     """Mesh from the configured axis sizes — with ELASTIC re-derivation
     (ISSUE 10): under the launcher, a restart may land on shrunk/grown
@@ -178,6 +289,20 @@ def main(namespace: argparse.Namespace) -> None:
                          "layer weights are what shard into pipeline "
                          "stages); without it the pipe axis would only "
                          "replicate work")
+
+    # Tuned layout (ISSUE 13): --partition_rules accepts the tuner's
+    # artifact verbatim (rules + mesh + ZeRO recommendations), and
+    # --auto_tune runs the tuner's screen inline — rank 0 measures, every
+    # rank loads the resulting artifact. Recommendations fold into the
+    # settings BEFORE the mesh is built; an explicit mesh flag always
+    # wins over a recommendation.
+    from ..parallel.partition import load_partition_artifact
+    artifact = load_partition_artifact(args.partition_rules)
+    if args.auto_tune and artifact is None:
+        artifact = run_inline_auto_tune(args, ckpt_path, rank)
+    args = apply_tuned_layout(args, artifact, jax.device_count(),
+                              n_hosts=jax.process_count())
+
     workload = create_model_from_config(**args.dict())
     # Elastic mesh derivation: re-derive axis sizes only when capacity
     # can actually have CHANGED under this worker — a restart attempt
@@ -242,7 +367,6 @@ def main(namespace: argparse.Namespace) -> None:
     # order resolved the resume target before construction, which a
     # walk-back would silently desync from the data stream.
     from ..chaos.goodput import beacon_max_step
-    from ..parallel.partition import parse_partition_rules
     from ..utils.checkpoint import load_meta
     loop = TrainLoop(
         model=workload,
@@ -275,9 +399,10 @@ def main(namespace: argparse.Namespace) -> None:
         # the lost last-checkpoint..crash window.
         recompute_until_step=beacon_max_step(ckpt_path),
         # Auto-sharding engine knobs: ZeRO-1 weight-update sharding and
-        # the per-run partition-rule override (parallel/partition.py).
+        # the per-run partition-rule override — from the parsed artifact
+        # (tuner output or a hand-written table; parallel/partition.py).
         shard_optimizer=args.shard_optimizer,
-        partition_rules=parse_partition_rules(args.partition_rules),
+        partition_rules=(artifact or {}).get("rules"),
         # Span tracing (obs/): --trace arms explicitly; the default
         # defers to the DPT_TRACE launcher env, so supervised rings
         # armed at the launcher trace every attempt.

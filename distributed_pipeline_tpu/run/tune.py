@@ -1,0 +1,219 @@
+"""Auto-tuner entry point: search layouts, emit a loadable artifact.
+
+    python -m distributed_pipeline_tpu.run.tune --family diffuseq \
+        --n_devices 2 --budget_s 240 --out_dir model_checkpoints/tune
+    # -> model_checkpoints/tune/tune_diffuseq_artifact.json
+    python -m distributed_pipeline_tpu.run.train \
+        --partition_rules model_checkpoints/tune/tune_diffuseq_artifact.json ...
+
+Enumerates partition-rule tables x mesh splits (tune/candidates.py),
+statically rejects what cannot shard, measures survivors in child
+processes (tune/measure.py), and drives successive halving + ABBA finals
+under the wall-clock budget (tune/search.py), journaling every trial to
+``<out_dir>/tune_trials.jsonl`` so an interrupted tune resumes. Several
+families share one journal (cids are family-prefixed); each emits its own
+``tune_<family>_artifact.json``.
+
+stdout carries ONE machine-readable JSON line (per-family winners +
+trial accounting); progress goes to stderr. The same screen is reachable
+inline from training via ``run/train.py --auto_tune``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..config.tune import TuneSettings
+
+
+def create_parser() -> argparse.ArgumentParser:
+    return TuneSettings.to_argparse()
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _echo(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def screen_for_workload(*, model_kwargs: Dict[str, Any], batch_size: int,
+                        microbatch: int, n_devices: int, journal_path: str,
+                        budget_s: float,
+                        artifact_path: str = "",
+                        axes: Tuple[str, ...] = ("data", "fsdp", "tensor"),
+                        include_zero1: bool = True,
+                        max_candidates: int = 0,
+                        screen_steps: int = 4, warmup_steps: int = 2,
+                        screen_only: bool = True,
+                        final_rounds: int = 6, final_window_steps: int = 4,
+                        child_timeout_s: float = 150.0,
+                        seed: int = 0,
+                        tracer: Any = None,
+                        echo: Callable[[str], None] = _echo,
+                        clock: Callable[[], float] = time.monotonic
+                        ) -> Dict[str, Any]:
+    """One family's search: enumerate -> validate -> measure (children)
+    -> rank -> (optionally) halve + ABBA final -> artifact. Shared by the
+    CLI below and ``run/train.py --auto_tune`` (which runs it
+    screen-only against the live run's model/shape and device count)."""
+    import jax
+
+    from ..models import create_model_from_config
+    from ..obs import trace as trace_lib
+    from ..parallel.partition import rules_for_workload, rules_to_json
+    from ..tune import candidates as cand_lib
+    from ..tune import measure as measure_lib
+    from ..tune import search as search_lib
+
+    if tracer is None:
+        tracer = trace_lib.NULL
+    family = model_kwargs["model_family"]
+    wl = create_model_from_config(**model_kwargs)
+    base_rules = rules_for_workload(wl)
+    if base_rules is None:
+        raise ValueError(
+            f"family {family!r} declares no partition-rule table — the "
+            f"tuner mutates a table, it cannot invent one (add the family "
+            f"to parallel/partition.py or declare workload."
+            f"partition_rules)")
+    shapes = cand_lib.param_shapes(wl)
+    cands = cand_lib.enumerate_candidates(
+        base_rules, n_devices, axes=axes, include_zero1=include_zero1,
+        max_candidates=max_candidates, prefix=f"{family}-")
+    microbatch = microbatch or batch_size
+    # children are single-process: the global microbatch IS the microbatch
+    force = n_devices if jax.default_backend() != "tpu" else None
+
+    def spec_of(cand: cand_lib.Candidate) -> Dict[str, Any]:
+        return {
+            "cid": cand.cid, "family": family,
+            "size": model_kwargs.get("model_size", "base"),
+            "batch": batch_size, "microbatch": microbatch,
+            "seq_len": model_kwargs.get("seq_len", 128),
+            "vocab": model_kwargs.get("vocab_size", 8192),
+            "hidden": model_kwargs.get("hidden_size", 0),
+            "layers": model_kwargs.get("num_layers", 0),
+            "heads": model_kwargs.get("num_heads", 0),
+            "dtype": model_kwargs.get("dtype", "float32"),
+            "seed": seed,
+            "mesh": dict(cand.mesh),
+            "shard_optimizer": cand.shard_optimizer,
+            "rules": rules_to_json(cand.rules),
+        }
+
+    env = measure_lib.child_env(force)
+
+    def measure_fn(cand: cand_lib.Candidate, steps: int) -> Dict[str, Any]:
+        return measure_lib.run_child(
+            "distributed_pipeline_tpu.tune.measure",
+            ["--spec", json.dumps(spec_of(cand)), "--steps", str(steps),
+             "--warmup", str(warmup_steps)],
+            env=env, timeout_s=child_timeout_s, cwd=REPO_ROOT,
+            tag=f"tune child {cand.cid}")
+
+    def pair_fn(a: cand_lib.Candidate,
+                b: cand_lib.Candidate) -> Dict[str, Any]:
+        return measure_lib.run_child(
+            "distributed_pipeline_tpu.tune.measure",
+            ["--spec", json.dumps(spec_of(a)),
+             "--spec_b", json.dumps(spec_of(b)),
+             "--rounds", str(final_rounds),
+             "--window_steps", str(final_window_steps),
+             "--warmup", str(warmup_steps)],
+            env=env, timeout_s=child_timeout_s * 2, cwd=REPO_ROOT,
+            tag=f"tune final {a.cid}|{b.cid}")
+
+    summary = search_lib.run_search(
+        candidates=cands, shapes=shapes, n_devices=n_devices,
+        global_microbatch=microbatch, measure_fn=measure_fn,
+        pair_fn=pair_fn, journal_path=journal_path, budget_s=budget_s,
+        screen_steps=screen_steps, screen_only=screen_only,
+        scope=family, tracer=tracer, echo=echo, clock=clock)
+    summary["family"] = family
+    if artifact_path and summary.get("winner"):
+        by_cid = {c.cid: c for c in cands}
+        winner = by_cid[summary["winner"]["cid"]]
+        search_lib.write_artifact(
+            artifact_path, winner, summary,
+            model={**model_kwargs, "batch_size": batch_size,
+                   "microbatch": microbatch})
+        summary["artifact"] = os.path.abspath(artifact_path)
+        echo(f"# tune: {family} winner {winner.cid} -> {artifact_path}")
+    return summary
+
+
+def main(ns: argparse.Namespace) -> Dict[str, Any]:
+    settings = TuneSettings.from_argparse(ns)
+    os.makedirs(settings.out_dir, exist_ok=True)
+    journal = os.path.join(settings.out_dir, "tune_trials.jsonl")
+    if not settings.resume and os.path.exists(journal):
+        os.unlink(journal)
+
+    import jax
+
+    from ..obs import trace as trace_lib
+
+    n_devices = settings.n_devices or jax.device_count()
+    tracer = trace_lib.tracer_for(settings.out_dir, "tune",
+                                  armed=settings.trace or None)
+    axes = tuple(a.strip() for a in settings.axes.split(",") if a.strip())
+    families = [f.strip() for f in settings.family.split(",") if f.strip()]
+    t0 = time.monotonic()
+    results: Dict[str, Any] = {}
+    try:
+        for family in families:
+            remaining = settings.budget_s - (time.monotonic() - t0)
+            with tracer.span(f"tune {family}", "tune",
+                             args={"n_devices": n_devices}):
+                results[family] = screen_for_workload(
+                    model_kwargs=dict(
+                        model_family=family,
+                        model_size=settings.model_size,
+                        seq_len=settings.seq_len,
+                        vocab_size=settings.vocab_size,
+                        hidden_size=settings.hidden_size,
+                        num_layers=settings.num_layers,
+                        num_heads=settings.num_heads,
+                        dtype=settings.dtype),
+                    batch_size=settings.batch_size,
+                    microbatch=settings.microbatch,
+                    n_devices=n_devices,
+                    journal_path=journal,
+                    budget_s=max(0.0, remaining),
+                    artifact_path=os.path.join(
+                        settings.out_dir,
+                        f"tune_{family}_artifact.json"),
+                    axes=axes,
+                    include_zero1=settings.include_zero1,
+                    max_candidates=settings.max_candidates,
+                    screen_steps=settings.screen_steps,
+                    warmup_steps=settings.warmup_steps,
+                    screen_only=settings.screen_only,
+                    final_rounds=settings.final_rounds,
+                    final_window_steps=settings.final_window_steps,
+                    child_timeout_s=settings.child_timeout_s,
+                    seed=settings.seed,
+                    tracer=tracer)
+    finally:
+        tracer.close()
+    out = {
+        "families": results,
+        "n_devices": n_devices,
+        "budget_s": settings.budget_s,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "journal": os.path.abspath(journal),
+        "out_dir": os.path.abspath(settings.out_dir),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    main(create_parser().parse_args())

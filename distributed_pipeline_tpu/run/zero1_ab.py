@@ -5,11 +5,15 @@ axis even on the single-device CPU smoke box (the parent forces
 ``--xla_force_host_platform_device_count=2`` there; on TPU the real
 devices are used as-is). Two TrainLoops at identical settings — ZeRO-1
 OFF and ON (``shard_optimizer``) — stay alive while short timed windows
-interleave between them in ABBA order, exactly the
-``measure_prefetch_ab`` protocol: sequential legs measure the box's rate
-drift as much as the code, interleaving hits both arms with the same
-drift, and even-round ABBA cancels the second-window position cost in
-the summed totals.
+interleave between them in ABBA order.
+
+The spawn/warmup/ABBA/footprint machinery lives in
+:mod:`..tune.measure` — ONE owner for child-process layout measurement,
+shared with the auto-tuner (ISSUE 13 satellite: this module used to
+carry its own copy). This entry keeps only the ZeRO-specific spec pair
+(OFF arm first, so the ON arm's RecompileMonitor never sees the OFF
+arm's construction compiles) and the legacy row schema the bench leg
+parses.
 
 Prints ONE machine-readable JSON row on stdout (the parent parses the
 last line): steps/s for both arms, the paired delta, and the
@@ -22,8 +26,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
-import time
 
 
 def create_parser() -> argparse.ArgumentParser:
@@ -45,106 +47,60 @@ def create_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> None:
     args = create_parser().parse_args(argv)
-    rounds = args.rounds + (args.rounds % 2)  # even: ABBA position balance
 
-    import jax
-
-    from ..data import load_data_from_args
-    from ..models import create_model_from_config
-    from ..parallel import make_mesh
+    from ..tune import measure
     from ..utils import logger
-    from ..utils.trainer import TrainLoop
 
     # stdout carries the ONE JSON row; silence the logger's default sink.
     logger.configure(format_strs=[])
 
-    dataset = "synthetic-lm" if args.family == "gpt2" else "synthetic-seq2seq"
+    # Both arms sanitize (symmetric timing; recompile gauge rides the ON
+    # arm). mesh=None -> make_mesh(dp=-1): all devices on the data axis —
+    # the pure-DP mesh is where ZeRO-1 buys the most and the layouts
+    # differ the most.
+    def spec(shard: bool) -> dict:
+        return {
+            "cid": f"zero1-{'on' if shard else 'off'}",
+            "family": args.family, "size": args.size,
+            "batch": args.batch, "microbatch": args.microbatch,
+            "seq_len": args.seq_len, "vocab": args.vocab,
+            "hidden": args.hidden, "layers": args.layers,
+            "heads": args.heads, "dtype": args.dtype,
+            "mesh": None, "rules": None, "shard_optimizer": shard,
+        }
 
-    def build(shard: bool) -> TrainLoop:
-        wl = create_model_from_config(
-            model_family=args.family, model_size=args.size,
-            seq_len=args.seq_len, vocab_size=args.vocab,
-            hidden_size=args.hidden, num_layers=args.layers,
-            num_heads=args.heads, dtype=args.dtype)
-        data = load_data_from_args(
-            "train", batch_size=args.batch, dataset=dataset,
-            seq_len=args.seq_len, vocab_size=args.vocab, seed=0,
-            num_loader_proc=2)
-        # Both arms sanitize (symmetric timing; recompile gauge rides the
-        # ON arm). All devices on the data axis: the pure-DP mesh is where
-        # ZeRO-1 buys the most and the layouts differ the most.
-        return TrainLoop(model=wl, data=data, batch_size=args.batch,
-                         microbatch=args.microbatch or args.batch, lr=1e-4,
-                         ema_rate="0.9999", learning_steps=0,
-                         log_interval=10 ** 9, save_interval=10 ** 9,
-                         mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0,
-                         sanitize=True, shard_optimizer=shard)
-
-    def warmup(loop: TrainLoop) -> None:
-        for _ in range(3):
-            m = loop.run_step(loop.next_batch())
-        float(jax.device_get(m["loss"]))
-
-    def window(loop: TrainLoop) -> float:
-        t0 = time.perf_counter()
-        for _ in range(args.window_steps):
-            m = loop.run_step(loop.next_batch())
-        float(jax.device_get(m["loss"]))
-        return time.perf_counter() - t0
-
-    # OFF arm built and warmed FIRST so the ON arm's RecompileMonitor
-    # never sees the OFF arm's construction compiles (the
-    # measure_prefetch_ab ordering rationale); uninstalled in reverse.
-    loop_off = build(False)
-    try:
-        warmup(loop_off)
-        loop_on = build(True)
-        try:
-            warmup(loop_on)
-            off_dts: list = []
-            on_dts: list = []
-            for r in range(rounds):
-                pair = ((loop_off, off_dts), (loop_on, on_dts))
-                for loop, dts in (pair[::-1] if r % 2 else pair):
-                    dts.append(window(loop))
-            fp_on = loop_on.footprint()
-            fp_off = loop_off.footprint()
-            steady_recompiles = loop_on.steady_recompile_count
-        finally:
-            recompiles = loop_on.stop_sanitizer()
-    finally:
-        loop_off.stop_sanitizer()
-
-    n_steps = rounds * args.window_steps
-    off_sps = n_steps / sum(off_dts)
-    on_sps = n_steps / sum(on_dts)
-    mesh_dp = loop_on.mesh.shape["data"]
-    opt_pr_on = fp_on["opt_state_bytes_per_replica"]
-    opt_pr_off = fp_off["opt_state_bytes_per_replica"]
+    # OFF arm is spec A (built and warmed FIRST — see measure_pair's
+    # monitor-ordering contract), ON arm is spec B (the measured arm).
+    pair = measure.measure_pair(spec(False), spec(True),
+                                rounds=args.rounds,
+                                window_steps=args.window_steps)
+    off, on = pair["a"], pair["b"]
+    opt_pr_on = on["opt_state_bytes_per_replica"]
+    opt_pr_off = off["opt_state_bytes_per_replica"]
     out = {
-        "steps_per_s": round(on_sps, 4),
-        "ab_off_steps_per_s": round(off_sps, 4),
-        # identical step counts: the totals ratio IS the rate ratio
-        "ab_delta_pct": round(100.0 * (sum(off_dts) / sum(on_dts) - 1.0), 2),
-        "ab_method": "paired-interleaved",
-        "ab_rounds": rounds, "ab_window_steps": args.window_steps,
-        "dp": mesh_dp,
-        "n_devices": jax.device_count(),
+        "steps_per_s": on["steps_per_s"],
+        "ab_off_steps_per_s": off["steps_per_s"],
+        "ab_delta_pct": pair["ab_delta_pct"],
+        "ab_method": pair["ab_method"],
+        "ab_rounds": pair["ab_rounds"],
+        "ab_window_steps": pair["ab_window_steps"],
+        "dp": on["dp"],
+        "n_devices": on["n_devices"],
         "batch": args.batch, "microbatch": args.microbatch or args.batch,
         "seq_len": args.seq_len,
-        "n_params": loop_on.n_params,
-        "params_bytes": fp_on["params_bytes"],
-        "opt_state_bytes": fp_on["opt_state_bytes"],
+        "n_params": on["n_params"],
+        "params_bytes": on["params_bytes"],
+        "opt_state_bytes": on["opt_state_bytes"],
         "opt_state_bytes_per_replica": opt_pr_on,
         "ab_off_opt_state_bytes_per_replica": opt_pr_off,
         # the acceptance number: ~dp when every big leaf shards
         "opt_bytes_replica_ratio": round(opt_pr_off / max(opt_pr_on, 1), 2),
-        "ema_bytes_per_replica": fp_on["ema_bytes_per_replica"],
-        "ab_off_ema_bytes_per_replica": fp_off["ema_bytes_per_replica"],
-        "peak_live_bytes": fp_on["peak_live_bytes"],
-        "compile_s": round(loop_on.compile_time_s or 0.0, 3),
-        "recompile_count": recompiles,
-        "steady_recompile_count": steady_recompiles,
+        "ema_bytes_per_replica": on["ema_bytes_per_replica"],
+        "ab_off_ema_bytes_per_replica": off["ema_bytes_per_replica"],
+        "peak_live_bytes": on["peak_live_bytes"],
+        "compile_s": on["compile_s"],
+        "recompile_count": on["recompile_count"],
+        "steady_recompile_count": on["steady_recompile_count"],
     }
     print(json.dumps(out), flush=True)
 

@@ -442,6 +442,7 @@ class ServingFleet:
                  restart_backoff_s: float = 0.25,
                  restart_backoff_max_s: float = 5.0,
                  monitor_interval: float = 0.05,
+                 replica_platform: str = "cpu",
                  launch_fn: Optional[Callable[..., int]] = None) -> None:
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
@@ -450,6 +451,13 @@ class ServingFleet:
         self.worker_modname = worker_modname
         self.worker_argv = list(worker_argv)
         self.devices_per_proc = devices_per_proc
+        # Replica backend pin: "cpu" (the dev/test-ring default — forced
+        # fake devices, remote plugin disabled), a real platform name, or
+        # "" to inherit the environment (how TPU replicas run: the old
+        # unconditional launcher cpu pin made them impossible —
+        # run/serve.py resolves --replica_platform auto to the parent's
+        # platform before constructing the fleet).
+        self.replica_platform = replica_platform
         self.hang_timeout_s = hang_timeout_s
         self.hang_startup_timeout_s = hang_startup_timeout_s
         self.max_restarts = max_restarts
@@ -489,7 +497,8 @@ class ServingFleet:
                 hang_timeout_s=self.hang_timeout_s,
                 hang_startup_timeout_s=self.hang_startup_timeout_s,
                 extra_env={"DPT_REPLICA": str(i)},
-                tag=f"replica{i}")
+                tag=f"replica{i}",
+                worker_platform=self.replica_platform)
 
         for i in range(self.n_replicas):
             t = threading.Thread(target=_supervise, args=(i,),

@@ -1,0 +1,297 @@
+"""Tuner search space: partition-rule-table mutations x mesh-axis splits.
+
+A CANDIDATE is one complete layout decision for a model/shape on a device
+count: a mesh factorization over the searched axes, a rule table (a
+bounded mutation of the family's hand-tuned table), and the ZeRO-1
+weight-update toggle. The space is kept SMALL and STRUCTURED on purpose —
+per Mesh-TensorFlow, the useful layouts for a transformer are a handful
+of axis assignments, not a combinatorial soup — and everything that can
+be rejected without compiling IS rejected here:
+
+* the mesh product must equal the device count and the global microbatch
+  must divide by the batch-sharding axes (the TrainLoop constructor's own
+  contract, checked before a child process is ever spawned);
+* the rule table must COVER the model (``match_partition_rules`` over the
+  abstract param shapes raises on an uncovered path or an overlong spec —
+  the same validation the trainer would hit, paid once, statically);
+* a searched axis no array dim actually uses (every leaf's divisibility
+  fallback dropped it and the batch does not shard over it) is pure
+  replication of compute — rejected as degenerate;
+* two candidates whose EFFECTIVE layouts (post divisibility-fix, on this
+  mesh, for these shapes) are identical would compile the same program —
+  the later one is rejected as a duplicate, so the measurement budget is
+  spent on distinct programs only.
+
+Everything here is deterministic in (rules, n_devices, axes): the same
+inputs enumerate the same candidates in the same order with the same
+cids — the property the resumable trial journal keys on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..parallel.mesh import AXES
+from ..parallel.partition import Rules, match_partition_rules
+
+__all__ = [
+    "Candidate", "enumerate_candidates", "mesh_splits", "param_shapes",
+    "rule_variants", "map_rule_axes", "effective_spec",
+    "validate_candidate", "layout_signature",
+]
+
+# Axes the tuner searches by default. sequence/expert/pipe stay out of the
+# default space: they change step SEMANTICS (ring attention, MoE dispatch,
+# pipeline schedules) rather than just layout, so toggling them is a model
+# decision, not a tuner decision.
+DEFAULT_AXES: Tuple[str, ...] = ("data", "fsdp", "tensor")
+
+# Axes the batch itself shards over (parallel/mesh.py batch_spec): a mesh
+# axis in this set is never degenerate even when no PARAM uses it — the
+# per-device batch still shrinks by its size.
+_BATCH_AXES = frozenset(("data", "fsdp", "expert"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One layout decision: mesh sizes over the searched axes (absent axes
+    are 1), a rule table, a human tag for the table variant, and the
+    ZeRO-1 toggle. ``cid`` is the stable identity the trial journal and
+    the fault-injection env match against."""
+
+    cid: str
+    mesh: Dict[str, int]
+    rules: Rules
+    rules_tag: str
+    shard_optimizer: bool
+
+    @property
+    def is_baseline(self) -> bool:
+        """The hand-tuned reference point: the family table on the pure-DP
+        mesh with ZeRO off — exactly what an untuned run gets today."""
+        return (self.rules_tag == "family" and not self.shard_optimizer
+                and all(v == 1 for a, v in self.mesh.items() if a != "data"))
+
+
+def param_shapes(workload: Any) -> Dict[str, Tuple[int, ...]]:
+    """'/'-joined param path -> shape, from ``jax.eval_shape`` — the whole
+    model's layout surface without materializing a single array."""
+    import flax.linen as nn
+    import jax
+
+    from ..parallel.partition import tree_path_name
+
+    abstract = nn.meta.unbox(
+        jax.eval_shape(workload.init_params, jax.random.PRNGKey(0)))
+    leaves, _ = jax.tree_util.tree_flatten_with_path(abstract)
+    return {tree_path_name(p): tuple(leaf.shape) for p, leaf in leaves}
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def mesh_splits(n_devices: int,
+                axes: Sequence[str] = DEFAULT_AXES) -> List[Dict[str, int]]:
+    """Every factorization of ``n_devices`` over ``axes`` (the last axis
+    takes the remainder), deterministic order: earlier axes ascending."""
+    splits: List[Dict[str, int]] = []
+
+    def rec(i: int, rem: int, acc: Dict[str, int]) -> None:
+        if i == len(axes) - 1:
+            splits.append({**acc, axes[i]: rem})
+            return
+        for d in _divisors(rem):
+            rec(i + 1, rem // d, {**acc, axes[i]: d})
+
+    rec(0, n_devices, {})
+    return splits
+
+
+def _map_entry(entry: Any, fn: Callable[[str], Optional[str]]) -> Any:
+    """Apply an axis-name mapping to one PartitionSpec entry (None, a
+    name, or a tuple of names); fn returning None drops the axis."""
+    if entry is None:
+        return None
+    if isinstance(entry, tuple):
+        mapped = tuple(m for m in (fn(a) for a in entry) if m is not None)
+        if not mapped:
+            return None
+        return mapped if len(mapped) > 1 else mapped[0]
+    return fn(entry)
+
+
+def map_rule_axes(rules: Rules,
+                  fn: Callable[[str], Optional[str]]) -> Rules:
+    from jax.sharding import PartitionSpec as P
+
+    return tuple(
+        (pat, P(*(_map_entry(e, fn) for e in tuple(spec))))
+        for pat, spec in rules)
+
+
+def rule_variants(base: Rules) -> List[Tuple[str, Rules]]:
+    """The bounded table-mutation family searched per mesh:
+
+    * ``family``    — the hand-tuned table as declared (the baseline);
+    * ``replicate`` — everything replicated (pure-DP layout: the control
+      that tells you whether sharding helps AT ALL on this shape);
+    * ``swap-fsdp-tensor`` — axis reassignment: every fsdp dim becomes
+      tensor and vice versa (column/row-parallel choices flipped);
+    * ``no-fsdp`` / ``no-tensor`` — per-group shard->replicate toggles:
+      drop one axis family from the table, keeping the other.
+
+    Mutations that degenerate to an existing layout on a given mesh (e.g.
+    ``no-tensor`` when the mesh has no tensor axis) are caught later by
+    the duplicate-layout signature, not here — the variants stay a pure
+    function of the table alone."""
+    from jax.sharding import PartitionSpec as P
+
+    swap = {"fsdp": "tensor", "tensor": "fsdp"}
+    return [
+        ("family", base),
+        ("replicate", ((r".*", P()),)),
+        ("swap-fsdp-tensor",
+         map_rule_axes(base, lambda a: swap.get(a, a))),
+        ("no-fsdp", map_rule_axes(base,
+                                  lambda a: None if a == "fsdp" else a)),
+        ("no-tensor", map_rule_axes(base,
+                                    lambda a: None if a == "tensor" else a)),
+    ]
+
+
+def enumerate_candidates(base_rules: Rules, n_devices: int, *,
+                         axes: Sequence[str] = DEFAULT_AXES,
+                         include_zero1: bool = True,
+                         max_candidates: int = 0,
+                         prefix: str = "") -> List[Candidate]:
+    """The full (pre-validation) candidate list, baseline first.
+
+    ``prefix`` namespaces cids (one journal can hold several families);
+    ``max_candidates`` truncates AFTER the baseline-first reorder, so a
+    capped search always still contains the reference point it must
+    reproduce-or-beat."""
+    cands: List[Candidate] = []
+    variants = rule_variants(base_rules)
+    for mesh in mesh_splits(n_devices, axes):
+        zero_opts = ([False, True]
+                     if include_zero1 and mesh.get("data", 1) > 1
+                     else [False])
+        for tag, rules in variants:
+            for zero in zero_opts:
+                mesh_id = "x".join(str(mesh[a]) for a in axes)
+                cid = f"{prefix}m{mesh_id}-{tag}-z{int(zero)}"
+                cands.append(Candidate(cid=cid, mesh=dict(mesh),
+                                       rules=rules, rules_tag=tag,
+                                       shard_optimizer=zero))
+    cands.sort(key=lambda c: not c.is_baseline)  # stable: baseline first
+    if max_candidates > 0:
+        cands = cands[:max_candidates]
+    return cands
+
+
+# --------------------------------------------------------- static validation
+
+def _axes_size(sizes: Dict[str, int], entry: Any) -> int:
+    axes = entry if isinstance(entry, tuple) else (entry,) if entry else ()
+    p = 1
+    for a in axes:
+        p *= sizes.get(a, 1)
+    return p
+
+
+def effective_spec(sizes: Dict[str, int], spec: Any,
+                   shape: Tuple[int, ...]) -> Tuple[Any, ...]:
+    """The layout this spec MATERIALIZES to on a mesh with these axis
+    sizes — ``partition.fix_spec`` semantics (pad to rank, drop axes whose
+    size the dim does not divide) restated over a plain size dict, so
+    validation never needs a live ``Mesh`` (or any devices at all)."""
+    entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    return tuple(
+        ax if _axes_size(sizes, ax) > 1 and dim % _axes_size(sizes, ax) == 0
+        else None
+        for dim, ax in zip(shape, entries))
+
+
+def _effective_layout(cand: Candidate,
+                      shapes: Dict[str, Tuple[int, ...]]
+                      ) -> Tuple[Dict[str, int],
+                                 Dict[str, Tuple[Any, ...]]]:
+    """(axis sizes, name -> effective spec) for a candidate — the one
+    rule-table walk both validation and the signature share. Raises
+    ValueError on coverage/overlong failures (match_partition_rules)."""
+    sizes = {a: 1 for a in AXES}
+    sizes.update(cand.mesh)
+    specs = match_partition_rules(cand.rules, _shape_tree(shapes))
+    eff = {name: effective_spec(sizes, specs[name], shape)
+           for name, shape in shapes.items()}
+    return sizes, eff
+
+
+def _signature_of(cand: Candidate, sizes: Dict[str, int],
+                  eff: Dict[str, Tuple[Any, ...]]) -> Any:
+    zero_eff = cand.shard_optimizer and sizes.get("data", 1) > 1
+    return (tuple(sorted(sizes.items())),
+            tuple(sorted(eff.items())), zero_eff)
+
+
+def layout_signature(cand: Candidate,
+                     shapes: Dict[str, Tuple[int, ...]]) -> Any:
+    """Hashable identity of the PROGRAM a candidate would compile: mesh
+    sizes + every leaf's effective spec + whether ZeRO-1 actually bites
+    (dp > 1). Two candidates with equal signatures are the same layout —
+    measuring both would spend budget re-timing one program."""
+    sizes, eff = _effective_layout(cand, shapes)
+    return _signature_of(cand, sizes, eff)
+
+
+def _shape_tree(shapes: Dict[str, Tuple[int, ...]]) -> Dict[str, Any]:
+    """Shape dict -> a tree ``match_partition_rules`` accepts (leaves need
+    only ``.shape``; dict keys ARE the '/'-joined paths)."""
+    import jax
+    import jax.numpy as jnp
+
+    return {name: jax.ShapeDtypeStruct(shape, jnp.float32)
+            for name, shape in shapes.items()}
+
+
+def validate_candidate(cand: Candidate,
+                       shapes: Dict[str, Tuple[int, ...]],
+                       n_devices: int,
+                       global_microbatch: int
+                       ) -> Tuple[bool, str, Optional[Any]]:
+    """(ok, reject_reason, layout_signature) — everything that can be
+    decided WITHOUT compiling. Order matters: cheap arithmetic first,
+    the rule-coverage walk last."""
+    sizes = {a: 1 for a in AXES}
+    sizes.update(cand.mesh)
+    product = 1
+    for v in sizes.values():
+        product *= v
+    if product != n_devices:
+        return (False,
+                f"mesh product {product} != device count {n_devices}", None)
+    dpf = sizes["data"] * sizes["fsdp"] * sizes["expert"]
+    if global_microbatch % dpf:
+        return (False,
+                f"global microbatch {global_microbatch} not divisible by "
+                f"data x fsdp x expert = {dpf}", None)
+    try:
+        sizes, eff = _effective_layout(cand, shapes)
+    except ValueError as e:
+        return False, f"rules: {e}", None
+    used = set()
+    for entries in eff.values():
+        for entry in entries:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                used.add(a)
+    for axis, size in sizes.items():
+        if size > 1 and axis not in used and axis not in _BATCH_AXES:
+            return (False,
+                    f"degenerate: {axis} axis (size {size}) unused by "
+                    f"every leaf after divisibility — pure replication of "
+                    f"compute", None)
+    return True, "", _signature_of(cand, sizes, eff)

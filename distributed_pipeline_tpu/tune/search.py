@@ -1,0 +1,344 @@
+"""Successive-halving layout search with a resumable trial journal.
+
+The control loop the tuner exists for: statically validate every
+enumerated candidate (rejects are journaled, never compiled), SCREEN the
+survivors with a cheap short-horizon child measurement, then halve —
+re-measuring the surviving top half at a doubled horizon — until the
+top-2 remain, which settle it in a paired-ABBA FINAL (both layouts live
+in one child, interleaved windows, position-balanced delta: the only
+protocol this box's drift can't flip). All of it under a wall-clock
+budget: a candidate the budget can't afford journals as skipped, and the
+ranking proceeds on what WAS measured.
+
+Every trial appends one line to ``tune_trials.jsonl`` — written
+append-only + flushed, read back through the shared torn-tail-tolerant
+``chaos.goodput.read_journal`` reader — keyed by (kind, rung, cid). An
+interrupted tune rerun REPLAYS completed trials from the journal instead
+of re-measuring them, so resume is free and, with a deterministic
+measure function, the journal and winner are bit-identical across runs
+(the determinism contract tests/test_tune.py pins).
+
+Accounting invariant (the acceptance bar): over the screen rung, every
+enumerated candidate lands exactly one row —
+``rejected + measured + pruned + skipped == enumerated``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..chaos.goodput import read_journal
+from ..obs import trace as trace_lib
+from .candidates import Candidate, validate_candidate
+
+__all__ = ["append_journal", "read_trials", "run_search", "write_artifact"]
+
+
+def append_journal(path: str, row: dict) -> None:
+    """One-line atomic-append + flush (the beacon/journal discipline): a
+    kill mid-write leaves at most one torn tail line, which the shared
+    reader skips."""
+    line = json.dumps(row, separators=(",", ":"))
+    with open(path, "a") as f:
+        f.write(line + "\n")
+        f.flush()
+
+
+def read_trials(path: str) -> List[dict]:
+    """Journal rows (torn-tail tolerant) — the one-owner reader."""
+    return read_journal(path)
+
+
+def _rate(row: dict) -> float:
+    res = row.get("result") or {}
+    try:
+        return float(res.get("steps_per_s") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def run_search(*, candidates: List[Candidate],
+               shapes: Dict[str, Tuple[int, ...]],
+               n_devices: int,
+               global_microbatch: int,
+               measure_fn: Callable[[Candidate, int], Dict[str, Any]],
+               journal_path: str,
+               budget_s: float,
+               pair_fn: Optional[
+                   Callable[[Candidate, Candidate], Dict[str, Any]]] = None,
+               screen_steps: int = 4,
+               keep_top: int = 2,
+               screen_only: bool = False,
+               max_rungs: int = 4,
+               scope: str = "",
+               tracer: Any = trace_lib.NULL,
+               echo: Callable[[str], None] = lambda s: None,
+               clock: Callable[[], float] = time.monotonic
+               ) -> Dict[str, Any]:
+    """Drive the search; returns the summary dict (winner + counts +
+    baseline). ``measure_fn(cand, steps)`` and ``pair_fn(a, b)`` return
+    child result rows (an ``{"error": ...}`` row prunes, never raises);
+    injecting fakes of both (plus ``clock``) is how the tests pin
+    determinism and budget behavior without spawning children."""
+    t0 = clock()
+    prior: Dict[Tuple[str, int, str], dict] = {}
+    for row in read_trials(journal_path):
+        if isinstance(row, dict) and row.get("kind") in ("trial", "final",
+                                                         "summary"):
+            prior[(row["kind"], int(row.get("rung", 0)),
+                   str(row.get("cid")))] = row
+
+    def journal_once(kind: str, rung: int, cid: str, status: str, *,
+                     result: Optional[dict] = None,
+                     reason: str = "",
+                     dur_s: Optional[float] = None) -> Tuple[dict, bool]:
+        """Append unless an identical trial key already sits in the
+        journal (the resume path): replayed rows are NOT re-written, so
+        a resumed tune extends the same file instead of duplicating it."""
+        key = (kind, rung, cid)
+        if key in prior:
+            return prior[key], True
+        row: Dict[str, Any] = {"kind": kind, "rung": rung, "cid": cid,
+                               "status": status,
+                               "t": round(time.time(), 3)}
+        if reason:
+            row["reason"] = reason
+        if dur_s is not None:
+            row["dur_s"] = round(dur_s, 3)
+        if result is not None:
+            row["result"] = result
+        append_journal(journal_path, row)
+        prior[key] = row
+        return row, False
+
+    counts = {"enumerated": len(candidates), "rejected": 0, "measured": 0,
+              "pruned": 0, "skipped": 0}
+
+    # ---------------------------------------------------- static rejection
+    valid: List[Candidate] = []
+    seen_sigs: Dict[Any, str] = {}
+    for cand in candidates:
+        ok, reason, sig = validate_candidate(cand, shapes, n_devices,
+                                             global_microbatch)
+        if ok and sig in seen_sigs:
+            ok, reason = False, f"duplicate-layout-of:{seen_sigs[sig]}"
+        if not ok:
+            journal_once("trial", 0, cand.cid, "rejected", reason=reason)
+            counts["rejected"] += 1
+            continue
+        seen_sigs[sig] = cand.cid
+        valid.append(cand)
+    echo(f"# tune: {len(candidates)} enumerated, "
+         f"{counts['rejected']} rejected statically, "
+         f"{len(valid)} to measure")
+
+    # ------------------------------------------------------------- screen
+    def run_trial(cand: Candidate, rung: int, steps: int
+                  ) -> Optional[dict]:
+        """Measure (or replay) one trial; returns the journal row, or
+        None when the budget skipped it. Completed/pruned trials replay
+        from the journal; a prior run's budget-SKIPPED trial is retried
+        (this run has fresh budget) — its new row appends after the old
+        one, and recovery reads take the last row per key."""
+        key = ("trial", rung, cand.cid)
+        prev = prior.get(key)
+        if prev is not None and prev.get("status") != "skipped":
+            return prev
+        if clock() - t0 > budget_s:
+            if prev is None:
+                journal_once("trial", rung, cand.cid, "skipped",
+                             reason="budget")
+            return None
+        t_wall = time.time()
+        w = trace_lib.Stopwatch()
+        res = measure_fn(cand, steps)
+        dur = w.lap_s()
+        status = "pruned" if "error" in res else "measured"
+        row = {"kind": "trial", "rung": rung, "cid": cand.cid,
+               "status": status, "t": round(time.time(), 3),
+               "dur_s": round(dur, 3), "result": res}
+        append_journal(journal_path, row)
+        prior[key] = row
+        if tracer.enabled:
+            tracer.complete(f"trial {cand.cid}", "tune", t_wall, dur,
+                            args={"cid": cand.cid, "rung": rung,
+                                  "status": status,
+                                  "steps_per_s": _rate(row) or None})
+        echo(f"# tune: rung {rung} {cand.cid}: {status}"
+             + (f" {_rate(row):.4f} steps/s" if status == "measured"
+                else f" ({res.get('error', '')[:120]})"))
+        return row
+
+    measured: List[Tuple[Candidate, dict]] = []
+    baseline_row: Optional[dict] = None
+    for cand in valid:
+        row = run_trial(cand, 0, screen_steps)
+        if row is None:
+            counts["skipped"] += 1
+            continue
+        # run_trial only ever returns measured/pruned rows: a prior run's
+        # skipped row is retried (not replayed) and a fresh budget skip
+        # returns None, counted above
+        if row.get("status") == "measured":
+            counts["measured"] += 1
+            measured.append((cand, row))
+            if cand.is_baseline:
+                baseline_row = row
+        else:
+            counts["pruned"] += 1
+
+    # ranking: rate desc, enumeration order as the deterministic tiebreak
+    order = {c.cid: i for i, c in enumerate(valid)}
+    rank = lambda pairs: sorted(
+        pairs, key=lambda cr: (-_rate(cr[1]), order[cr[0].cid]))
+    survivors = rank(measured)
+
+    # ------------------------------------------------ successive halving
+    rung, steps = 1, screen_steps * 2
+    while (not screen_only and len(survivors) > keep_top
+           and rung <= max_rungs):
+        if clock() - t0 > budget_s:
+            echo(f"# tune: budget spent before rung {rung}; ranking on "
+                 f"rung {rung - 1} rates")
+            break
+        keep = max(keep_top, math.ceil(len(survivors) / 2))
+        survivors = survivors[:keep]
+        next_round: List[Tuple[Candidate, dict]] = []
+        for cand, prev in survivors:
+            row = run_trial(cand, rung, steps)
+            if row is None:
+                # budget ran out mid-rung: keep the candidate at its
+                # previous-rung rate rather than dropping a survivor
+                next_round.append((cand, prev))
+            elif row.get("status") == "measured":
+                next_round.append((cand, row))
+            # pruned at the longer horizon: drops out of the ranking
+        survivors = rank(next_round)
+        rung, steps = rung + 1, steps * 2
+
+    # ------------------------------------------------------------- finals
+    final_row: Optional[dict] = None
+    winner: Optional[Candidate] = None
+    winner_row: Optional[dict] = None
+    if survivors:
+        winner, winner_row = survivors[0]
+    if (not screen_only and pair_fn is not None and len(survivors) >= 2
+            and clock() - t0 <= budget_s):
+        (a, row_a), (b, row_b) = survivors[0], survivors[1]
+        fid = f"{a.cid}|{b.cid}"
+        key = ("final", 0, fid)
+        if key in prior:
+            final_row = prior[key]
+        else:
+            t_wall = time.time()
+            w = trace_lib.Stopwatch()
+            res = pair_fn(a, b)
+            dur = w.lap_s()
+            status = "pruned" if "error" in res else "measured"
+            final_row, _ = journal_once("final", 0, fid, status,
+                                        result=res, dur_s=dur)
+            if tracer.enabled:
+                tracer.complete(f"final {fid}", "tune", t_wall, dur,
+                                args={"cid": fid, "status": status})
+        res = final_row.get("result") or {}
+        if final_row.get("status") == "measured":
+            # ab_delta_pct > 0 means arm B (the challenger) ran faster
+            if float(res.get("ab_delta_pct") or 0.0) > 0:
+                winner, winner_row = b, final_row
+            else:
+                winner, winner_row = a, final_row
+            echo(f"# tune: final {fid}: delta "
+                 f"{res.get('ab_delta_pct')}% -> {winner.cid}")
+
+    # ------------------------------------------------------------ summary
+    accounted = (counts["rejected"] + counts["measured"]
+                 + counts["pruned"] + counts["skipped"])
+    summary: Dict[str, Any] = {
+        "n_devices": n_devices,
+        "counts": counts,
+        "accounted": accounted,
+        "journal": os.path.abspath(journal_path),
+        "baseline_steps_per_s": (_rate(baseline_row)
+                                 if baseline_row else None),
+    }
+    if winner is not None:
+        win_res = (winner_row or {}).get("result") or {}
+        if "a" in win_res or "b" in win_res:  # finals row: pick the arm,
+            # but keep the winner's RUNG trial as the base — finals arm
+            # rows only re-time, and e.g. arm A carries no recompile
+            # gauges (only the second-built loop's monitor is clean), so
+            # the footprint/recompile fields must come from the screen
+            arm = ("b" if (win_res.get("ab_delta_pct") or 0) > 0 else "a")
+            arm_res = win_res.get(arm) or {}
+            rung_res = next((r.get("result") or {}
+                             for c, r in survivors
+                             if c.cid == winner.cid), {})
+            win_res = {**rung_res,
+                       **{k: v for k, v in arm_res.items()
+                          if v is not None}}
+        summary["winner"] = {
+            "cid": winner.cid,
+            "mesh": dict(winner.mesh),
+            "rules_tag": winner.rules_tag,
+            "shard_optimizer": winner.shard_optimizer,
+            "steps_per_s": win_res.get("steps_per_s"),
+            "opt_state_bytes_per_replica":
+                win_res.get("opt_state_bytes_per_replica"),
+            "peak_live_bytes": win_res.get("peak_live_bytes"),
+            "steady_recompile_count":
+                win_res.get("steady_recompile_count"),
+        }
+    else:
+        summary["winner"] = None
+        summary["error"] = "no candidate was measured successfully"
+    # One summary row per SCOPE (several families share one journal —
+    # the cid is the family tag), re-appended only when its content
+    # changed: a no-op resume leaves the journal byte-identical, a
+    # resume that retried skipped trials records the updated totals.
+    scope_cid = scope or "summary"
+    sum_result = {k: summary[k] for k in
+                  ("counts", "accounted", "winner",
+                   "baseline_steps_per_s")}
+    prev_sum = prior.get(("summary", -1, scope_cid))
+    if prev_sum is None or prev_sum.get("result") != sum_result:
+        row = {"kind": "summary", "rung": -1, "cid": scope_cid,
+               "status": "ok" if winner is not None else "empty",
+               "t": round(time.time(), 3), "result": sum_result}
+        append_journal(journal_path, row)
+        prior[("summary", -1, scope_cid)] = row
+    return summary
+
+
+def write_artifact(path: str, winner: Candidate,
+                   summary: Dict[str, Any],
+                   model: Optional[Dict[str, Any]] = None) -> dict:
+    """Emit the winning layout as the ``--partition_rules`` artifact:
+    the rule table in the wire format ``parse_partition_rules`` reads, a
+    mesh-shape recommendation, and the ZeRO-1 flag — one JSON file
+    ``run/train.py --partition_rules <path>`` loads verbatim (the dict
+    form; a bare rule list stays equally valid input). Atomic write: a
+    reader never sees a torn artifact."""
+    from ..parallel.partition import rules_to_json
+
+    payload = {
+        "partition_rules": rules_to_json(winner.rules),
+        "mesh": dict(winner.mesh),
+        "shard_optimizer": winner.shard_optimizer,
+        "tuned": {
+            "cid": winner.cid,
+            "rules_tag": winner.rules_tag,
+            "n_devices": summary.get("n_devices"),
+            "steps_per_s": (summary.get("winner") or {}).get("steps_per_s"),
+            "baseline_steps_per_s": summary.get("baseline_steps_per_s"),
+            "model": model or {},
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, path)
+    return payload

@@ -88,8 +88,8 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
     # (headline + prefetch A/B twin + zero1 A/B + trace A/B + chaos +
-    # elastic + noaccum + moe8 + moe8-cf1 + scan)
-    assert len(final["configs"]) == 10
+    # elastic + tune + noaccum + moe8 + moe8-cf1 + scan)
+    assert len(final["configs"]) == 11
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
@@ -116,6 +116,7 @@ def test_bench_only_exact_match_with_optional_glob():
     legs = [(n, None) for n in (
         "diffuseq-base-seq128", "diffuseq-base-seq128-prefetch",
         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
+        "diffuseq-base-seq128-tune",
         "gpt2-serve-decode-b64", "gpt2-base-decode-oneshot-b1",
         "gpt2-serve-fleet-chaos")]
     names = lambda got: [n for n, _ in got]
@@ -123,7 +124,8 @@ def test_bench_only_exact_match_with_optional_glob():
         ["diffuseq-base-seq128"]
     assert names(bench.select_legs(legs, "diffuseq-base-seq128*")) == \
         ["diffuseq-base-seq128", "diffuseq-base-seq128-prefetch",
-         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos"]
+         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
+         "diffuseq-base-seq128-tune"]
     assert names(bench.select_legs(legs, "*serve-decode*")) == \
         ["gpt2-serve-decode-b64"]
     # the fleet leg must NOT ride the headline glob (it sits after it so
@@ -241,6 +243,52 @@ def test_fleet_bench_leg_meets_serving_slos(fleet_bench_run):
     assert row["accounted_frac"] == pytest.approx(1.0, abs=0.05)
     assert row["completed"] == row["requests"]
     assert row["replay_s"] >= 0 and row["fleet_attempts"] >= 4
+
+
+# ------------------------------------------------------ auto-tuner leg
+
+@pytest.fixture(scope="module")
+def tune_bench_run(tmp_path_factory):
+    """One bench subprocess filtered to the auto-tuner leg (ISSUE 13):
+    a screen-only budgeted layout search on the forced-host dp=2 CPU
+    mesh. slow-marked consumer: the leg spawns ~9 measurement children."""
+    tmp = tmp_path_factory.mktemp("tune_bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "240",
+        "BENCH_LEG_BUDGET_S": "240",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        "BENCH_ONLY": "diffuseq-base-seq128-tune",
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("DPT_TUNE_INJECT", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=420)
+    return proc, tmp / "legs.jsonl"
+
+
+@pytest.mark.slow
+def test_tune_bench_leg_reproduces_or_beats_hand_tuned(tune_bench_run):
+    """The acceptance row: the tuner's winner reproduces or beats the
+    hand-tuned family table within the +-3% band, every enumerated
+    candidate is accounted (measured + pruned + rejected + skipped ==
+    enumerated), and the winner holds steady recompiles at 0."""
+    proc, artifact = tune_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {r["name"]: r for r in
+            (json.loads(line) for line in
+             artifact.read_text().strip().splitlines())}
+    row = rows["diffuseq-base-seq128-tune"]
+    assert "error" not in row and "skipped" not in row, row
+    assert row["winner_vs_baseline"] >= 1.0 - row["noise_band_pct"] / 100
+    assert (row["measured"] + row["pruned"] + row["rejected"]
+            + row["skipped"]) == row["enumerated"]
+    assert row["enumerated"] > row["measured"] > 0
+    assert row["steady_recompile_count"] == 0
+    assert row["winner"].startswith("diffuseq-m")
 
 
 # ------------------------------------------------- trace-overhead A/B leg

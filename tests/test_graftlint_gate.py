@@ -41,6 +41,9 @@ GATED_PATHS = [
     # the observability tests drive TrainLoop outer loops (GL007) and
     # exercise the trace/export layer GL009 polices timing flows into
     os.path.join(ROOT, "tests", "test_obs.py"),
+    # the auto-tuner tests drive measurement TrainLoops (GL007) and
+    # handle rule tables / spec trees directly (GL008 territory)
+    os.path.join(ROOT, "tests", "test_tune.py"),
 ]
 
 
