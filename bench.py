@@ -168,7 +168,9 @@ def main() -> None:
     from distributed_pipeline_tpu.data import load_data_from_args
     from distributed_pipeline_tpu.models import create_model_from_config
     from distributed_pipeline_tpu.parallel import make_mesh
+    from distributed_pipeline_tpu.obs import ledger as ledger_lib
     from distributed_pipeline_tpu.utils.perf import (
+        active_param_count,
         enable_persistent_compilation_cache,
         mfu,
         transformer_train_flops_per_token,
@@ -187,6 +189,36 @@ def main() -> None:
     on_tpu = jax.default_backend() == "tpu"
     dtype = "bfloat16" if on_tpu else "float32"
     steps = 30 if on_tpu else 3
+
+    def _train_ledger_columns(loop, *, tps: float, fpt: float,
+                              steps_per_s: float, stall: dict) -> dict:
+        """The cost-ledger columns for one train row (ISSUE 14): the
+        executable's extracted collective/HBM traffic folded with THIS
+        leg's measured tokens/s and (MoE-active) flops/token into the
+        roofline MFU-gap attribution — so the row's ``mfu`` and its
+        ``mfu_gap_*`` terms share one numerator and the sum identity
+        (mfu + gaps == 1) holds exactly. The attribution arithmetic has
+        one owner (obs/ledger.py; graftlint GL010)."""
+        from distributed_pipeline_tpu.utils.perf import device_peak_flops
+
+        tr = loop.ledger_rows().get("train_step") or {}
+        att = ledger_lib.roofline_attribution(
+            tokens_per_s=tps, flops_per_token=fpt,
+            peak_flops=device_peak_flops(),
+            n_devices=jax.device_count(), steps_per_s=steps_per_s,
+            collective_bytes_per_step=tr.get("collective_bytes_per_step",
+                                             0.0),
+            bytes_accessed=tr.get("bytes_accessed", 0.0),
+            host_stall_s_per_step=(stall["data_wait_s"]
+                                   + stall["h2d_wait_s"]
+                                   + stall["dispatch_s"]),
+            device_kind=getattr(jax.devices()[0], "device_kind", "cpu"),
+            padding_waste_frac=tr.get("padding_waste_frac", 0.0))
+        cols = ledger_lib.attribution_columns(att)
+        for k in ("flops_per_execution", "bytes_accessed"):
+            if k in tr:
+                cols[k] = tr[k]
+        return cols
 
     def measure(name: str, *, family: str, size: str, seq_len: int,
                 batch, microbatch: int = 0, remat: bool = False,
@@ -249,13 +281,16 @@ def main() -> None:
         # regression (e.g. an unpinned sharding re-triggering step-2
         # compiles, the r6 bug class) shows up in BENCH artifacts as
         # recompile_count growth instead of a silent throughput dip.
+        # cost_ledger=True: every train row carries the per-program
+        # roofline attribution (obs/ledger.py) — the MFU gap explained,
+        # not just stated (ISSUE 14).
         loop = TrainLoop(model=wl, data=data, batch_size=batch,
                          microbatch=microbatch or batch, lr=1e-4,
                          ema_rate="0.9999", learning_steps=0,
                          log_interval=10 ** 9, save_interval=10 ** 9,
                          mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0,
                          sanitize=True, prefetch_depth=prefetch_depth,
-                         dispatch_lag=dispatch_lag)
+                         dispatch_lag=dispatch_lag, cost_ledger=True)
         # First step paid separately: with the AOT step (utils/trainer.py)
         # its wall time is compile + dispatch + one step, and
         # loop.compile_time_s isolates the lower()/compile() share — the
@@ -297,29 +332,12 @@ def main() -> None:
         finally:
             recompiles = loop.stop_sanitizer()
         tps = n_steady * batch * seq_len * jax.process_count() / dt
-        # MFU against ACTIVE params: a top-k routed MoE block only runs
-        # top_k of its moe_experts expert MLPs per token, so counting every
-        # expert's weights would overstate the model flops. Inactive mass
-        # is derived from the actual expert weight shapes (leading dim ==
-        # moe_experts under a "moe" module) so it tracks models/moe.py by
-        # construction.
-        n_active = loop.n_params
-        if moe_experts > moe_top_k:
-            import numpy as np
-            from jax.tree_util import tree_flatten_with_path
-            leaves, _ = tree_flatten_with_path(loop.state.params)
-            # expert dim position differs by layout: named blocks stack
-            # experts on dim 0 ([experts, ...]); MoEScanBlocks prepends a
-            # scan-group dim ([groups, experts, ...]) — accept either.
-            expert_params = sum(
-                int(np.prod(leaf.shape))
-                for path, leaf in leaves
-                if any("moe" in str(getattr(k, "key", k)) for k in path)
-                and leaf.ndim >= 2
-                and (leaf.shape[0] == moe_experts
-                     or (leaf.ndim >= 3 and leaf.shape[1] == moe_experts)))
-            n_active -= round(expert_params
-                              * (moe_experts - moe_top_k) / moe_experts)
+        # MFU against ACTIVE params: perf.active_param_count owns the
+        # top-k MoE adjustment (graftlint GL010: FLOPs-side accounting
+        # has one owner — this used to be ~20 inline lines here).
+        n_active = active_param_count(loop.state.params, loop.n_params,
+                                      moe_experts=moe_experts,
+                                      moe_top_k=moe_top_k)
         fpt = transformer_train_flops_per_token(
             n_active, wl.num_layers, wl.hidden_size, seq_len)
         row = {
@@ -353,6 +371,13 @@ def main() -> None:
         # dispatch->ready span, observed via the lagged fetch; 0.0 in
         # eager-dispatch legs, which never block on a step to measure it).
         row.update({k: round(v, 6) for k, v in stall.items()})
+        # Cost ledger (ISSUE 14): mfu (unrounded — the gap-sum identity
+        # must hold to 1e-6) + mfu_gap_host/comms/memory_bound/residual
+        # + collective_bytes_per_step + padding_waste_frac, off the leg's
+        # own compiled executable and timed window.
+        row.update(_train_ledger_columns(loop, tps=tps, fpt=fpt,
+                                         steps_per_s=n_steady / dt,
+                                         stall=stall))
         return row
 
     def measure_decode(name: str, *, gen_tokens: int, batch: int,
@@ -463,6 +488,17 @@ def main() -> None:
         # replicated decode state: the service rate IS the per-chip rate
         # (see measure_decode's no-division rationale)
         tps = server.tokens_fetched / dt
+        # Cost ledger (ISSUE 14): the decode executable's roofline
+        # attribution over the timed window (stats were reset after
+        # warmup, so tokens_fetched and wall line up), plus the prefill
+        # prompt-padding waste as its own column.
+        led = server.cost_ledger(wall_s=dt, n_devices=1)
+        ledger_cols = ledger_lib.attribution_columns(
+            led.get("serve_decode") or {})
+        pre = led.get("serve_prefill") or {}
+        if "padding_waste_frac" in pre:
+            ledger_cols["prefill_padding_waste_frac"] = \
+                pre["padding_waste_frac"]
         return {
             "name": name,
             "decode_tokens_per_s_per_chip": round(tps, 1),
@@ -477,6 +513,7 @@ def main() -> None:
             "compile_s": round(compile_s, 3),
             "first_request_s": round(first_request_s, 3),
             "recompile_count": steady_recompiles,
+            **ledger_cols,
         }
 
     def _run_supervised_ring(run_dir_name: str, plan: dict, ring_args,
@@ -1544,15 +1581,33 @@ def main() -> None:
     if artifact_path:
         open(artifact_path, "w").close()
 
+    # Bench HISTORY (ISSUE 14): unlike the per-run artifact, this file is
+    # APPEND-ONLY across runs — every leg row lands here stamped with this
+    # run's id, so the empty bench trajectory becomes a watched time
+    # series (obs/regress.py compares the newest run against a trailing
+    # baseline window). BENCH_HISTORY= (empty) disables.
+    history_path = os.environ.get("BENCH_HISTORY", "bench_history.jsonl")
+    run_id = f"{time.strftime('%Y%m%d-%H%M%S')}.{os.getpid()}"
+
     configs = []
 
     def emit(row: dict) -> None:
-        """Record one leg NOW: final-JSON list + JSONL artifact + stderr.
-        A later timeout/crash can only lose legs that never finished."""
+        """Record one leg NOW: final-JSON list + JSONL artifact + stderr
+        + history. A later timeout/crash can only lose legs that never
+        finished."""
         configs.append(row)
         if artifact_path:
             with open(artifact_path, "a") as f:
                 f.write(json.dumps(row) + "\n")
+        if history_path:
+            try:  # history is telemetry: a read-only disk must not
+                with open(history_path, "a") as f:  # sink the bench
+                    f.write(json.dumps({**row, "run_id": run_id,
+                                        "t": time.time()}) + "\n")
+                    f.flush()
+            except OSError as e:
+                print(f"# bench history append failed: {e}",
+                      file=sys.stderr, flush=True)
         print(f"# leg {json.dumps(row)} [t+"
               f"{time.perf_counter() - t_bench0:.0f}s]", file=sys.stderr,
               flush=True)
@@ -1565,10 +1620,15 @@ def main() -> None:
     printed = threading.Lock()
 
     def final_payload() -> str:
+        # a TRAIN row: serving rows also carry "mfu" now (the decode
+        # roofline attribution), so the headline pick keys on the
+        # train-schema column it actually reports
         if only:
-            head = next((c for c in configs if "mfu" in c), None)
+            head = next((c for c in configs
+                         if "tokens_per_sec_per_chip" in c), None)
         else:
-            head = configs[0] if configs and "mfu" in configs[0] else None
+            head = (configs[0] if configs
+                    and "tokens_per_sec_per_chip" in configs[0] else None)
         if only and head is not None:
             metric = (f"tokens/sec/chip ({head['name']} [BENCH_ONLY={only}], "
                       f"{jax.devices()[0].device_kind})")

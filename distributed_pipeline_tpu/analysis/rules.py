@@ -27,6 +27,15 @@ GL009 ad-hoc-timing        a raw time.time()/perf_counter()/monotonic()
                            (StallBreakdown, GoodputTracker, ServingTracker,
                            obs.trace spans/Stopwatch), where one owner
                            keeps the trace and the ledgers consistent
+GL010 unattributed-flops   a FLOPs/MFU figure computed from raw numeric
+                           constants (a literal inside a * / / **
+                           expression bound to a flops/mfu/fpt name or
+                           key) outside utils/perf.py and obs/ledger.py —
+                           FLOP accounting has two owners so every MFU
+                           figure in the repo shares one numerator with
+                           the roofline cost ledger; derive through
+                           transformer_train_flops_per_token /
+                           active_param_count / roofline_attribution
 """
 
 from __future__ import annotations
@@ -935,3 +944,97 @@ class AdHocTiming(Rule):
                         "raw clock delta accumulated into a metrics "
                         "mapping — use obs.trace.Stopwatch (or a perf "
                         "tracker) as the delta's owner")
+
+
+# --------------------------------------------------------------------- GL010
+
+# The two sanctioned owners of FLOPs/MFU arithmetic: the analytic
+# numerators (utils/perf.py) and the roofline attribution (obs/ledger.py).
+_GL010_EXEMPT_SUFFIXES = ("utils/perf.py", "obs/ledger.py")
+_GL010_ARITH_OPS = (ast.Mult, ast.Div, ast.Pow)
+
+
+def _gl010_exempt(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith(s) for s in _GL010_EXEMPT_SUFFIXES)
+
+
+def _gl010_name_hit(name: str) -> bool:
+    low = name.lower()
+    return ("mfu" in low or "flop" in low or low == "fpt"
+            or low.endswith("_fpt") or low.startswith("fpt_"))
+
+
+@register
+class UnattributedFlops(Rule):
+    """GL010: a FLOPs/MFU figure derived from raw numeric constants —
+    a literal participating in a ``*``/``/``/``**`` expression whose
+    result binds to a flops/mfu/fpt-named variable, keyword, or dict
+    key — outside the two sanctioned owners. Scattered ``6*N + 12*l*h*s``
+    re-derivations are how the repo's MFU numbers drift apart: each
+    inline copy silently disagrees with the cost ledger's (the bench's
+    MoE active-params adjustment lived exactly this way until it was
+    dogfooded into ``perf.active_param_count``). A pure call into the
+    owners (``transformer_train_flops_per_token(...)``, ``mfu(...)``,
+    ``roofline_attribution(...)``) — or any expression without literal
+    arithmetic — stays legal, so the rule gates without noise."""
+
+    code = "GL010-unattributed-flops"
+    description = ("FLOPs/MFU figure computed from raw numeric constants "
+                   "outside utils/perf.py|obs/ledger.py — derive it "
+                   "through the perf/ledger owners")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if _gl010_exempt(module.path):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _gl010_name_hit(node.targets[0].id):
+                yield from self._flag(module, node.value,
+                                      node.targets[0].id)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and _gl010_name_hit(node.target.id):
+                yield from self._flag(module, node.value, node.target.id)
+            elif isinstance(node, ast.keyword) and node.arg \
+                    and _gl010_name_hit(node.arg):
+                yield from self._flag(module, node.value, node.arg)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and _gl010_name_hit(k.value):
+                        yield from self._flag(module, v, k.value)
+
+    def _flag(self, module: Module, expr: ast.AST,
+              name: str) -> Iterator[Finding]:
+        hit = self._literal_arith(expr)
+        if hit is not None:
+            yield module.finding(
+                self, hit,
+                f"{name!r} computed from raw numeric constants — FLOPs/"
+                f"MFU arithmetic belongs to utils/perf.py (analytic "
+                f"numerators: transformer_train_flops_per_token, "
+                f"active_param_count, mfu) or obs/ledger.py (roofline "
+                f"attribution), so every figure shares one numerator "
+                f"with the cost ledger")
+
+    @staticmethod
+    def _literal_arith(expr: ast.AST) -> Optional[ast.AST]:
+        """A BinOp multiplying/dividing by a numeric literal inside
+        ``expr`` (not descending into nested function definitions)."""
+        stack: List[ast.AST] = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_NODES):
+                continue
+            if isinstance(n, ast.BinOp) \
+                    and isinstance(n.op, _GL010_ARITH_OPS):
+                for side in (n.left, n.right):
+                    if isinstance(side, ast.Constant) \
+                            and isinstance(side.value, (int, float)) \
+                            and not isinstance(side.value, bool):
+                        return n
+            stack.extend(ast.iter_child_nodes(n))
+        return None

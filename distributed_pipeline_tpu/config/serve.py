@@ -70,6 +70,13 @@ class ServeSettings(S):
                                     "request every N scheduler steps "
                                     "(0 = all queued at start)")
     out: str = _("", "write per-request JSONL results here")
+    cost_ledger: bool = _(False, "per-executable cost ledger (obs/"
+                                 "ledger.py): extract FLOPs/bytes/"
+                                 "collective accounting off the prefill/"
+                                 "decode AOT executables and attach the "
+                                 "decode roofline MFU-gap attribution "
+                                 "(+ prompt-padding / slot-occupancy "
+                                 "waste) to the summary JSON")
     sanitize: bool = _(False, "runtime sanitizer: count XLA compiles "
                               "(recompile_count must stay 0 in steady "
                               "state — prefill/decode compile exactly "

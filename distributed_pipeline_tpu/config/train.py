@@ -67,6 +67,18 @@ class GeneralSettings(S):
                            "DPT_TRACE env arms it too (reaches every "
                            "worker of a launcher ring, incl. "
                            "--config_json runs); off = zero-cost no-op")
+    cost_ledger: bool = _(False, "per-compiled-program cost ledger (obs/"
+                                 "ledger.py): extract XLA's FLOPs/bytes "
+                                 "accounting + an HLO collective-bytes "
+                                 "tally off the AOT step executables and "
+                                 "log the roofline MFU-gap attribution "
+                                 "(mfu_gap_host/comms/memory_bound/"
+                                 "residual, collective_bytes_per_step, "
+                                 "padding_waste_frac) each log window, "
+                                 "snapshotted to <run_dir>/"
+                                 "perf_ledger.json (read by run/"
+                                 "perf_report.py, run/status.py, and "
+                                 "obs/export.py counter tracks)")
     sanitize: bool = _(False, "runtime sanitizer mode: count every XLA "
                               "compile into a recompile_count gauge "
                               "(jax_log_compiles) and disallow implicit "

@@ -51,6 +51,15 @@ class TuneSettings(S):
                                "reference always survives the cap); "
                                "0 = no cap")
 
+    peak_bytes_ceiling: float = _(
+        0.0, "memory-headroom objective (ISSUE 14 satellite; the r15 "
+             "NOTE's unwired ranking input): candidates whose measured "
+             "peak_live_bytes exceed this ceiling are RANKED OUT — "
+             "journaled as over_ceiling with accounting still closed "
+             "(measured + pruned + rejected + skipped + over_ceiling == "
+             "enumerated) and never a winner. 0 disables. The xl "
+             "presets' path onto bigger meshes: the fastest layout that "
+             "does not fit is not a layout")
     budget_s: float = _(240.0, "wall-clock budget for the whole tune: "
                                "candidates the budget cannot afford are "
                                "journaled as skipped and the ranking "

@@ -11,10 +11,20 @@ backend import, the same discipline as :mod:`..chaos`):
   (:data:`~.trace.NULL`) makes tracing-off free: no span objects, no
   writes, no branches beyond one attribute check.
 * :mod:`.export` — folds a run (or fleet) dir's trace shards + beacons +
-  ``attempts.jsonl`` + the router ``journal.jsonl`` into ONE
-  Chrome-trace-event / Perfetto-loadable timeline (one pid per
-  process/replica, one track per category) plus a Prometheus-textfile
-  metrics snapshot.
+  ``attempts.jsonl`` + the router ``journal.jsonl`` (+ the cost ledger
+  as counter tracks) into ONE Chrome-trace-event / Perfetto-loadable
+  timeline (one pid per process/replica, one track per category) plus a
+  Prometheus-textfile metrics snapshot.
+* :mod:`.ledger` — the per-compiled-program COST LEDGER (ISSUE 14):
+  XLA ``cost_analysis``/``memory_analysis`` extraction, the HLO
+  collective-bytes tally, and the roofline MFU-gap attribution
+  (``mfu + gap_host + gap_comms + gap_memory_bound + gap_residual == 1``
+  exactly), snapshotted to ``<run_dir>/perf_ledger.json`` behind
+  ``--cost_ledger`` and rendered by ``run/perf_report.py``.
+* :mod:`.regress` — the bench-history regression sentinel: newest
+  recorded bench run vs a trailing baseline window, per-leg verdicts on
+  tokens/s / MFU / peak bytes / steady recompiles, nonzero exit on a
+  past-band regression (CI-gateable).
 * ``run/status.py`` — the live, read-only fleet status CLI built on the
   same readers.
 

@@ -44,6 +44,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..chaos import goodput
+from . import ledger as ledger_lib
 from .trace import read_trace, request_trace_id
 
 __all__ = ["chrome_trace", "collect_sources", "is_fleet_dir",
@@ -159,6 +160,40 @@ def _tune_trial_events(d: str) -> List[dict]:
         else:
             events.append({"ph": "i", "name": name, "cat": "tune",
                            "t": t, "args": args})
+    return events
+
+
+def _ledger_events(run_dir: str) -> List[dict]:
+    """``perf_ledger.json`` -> Perfetto COUNTER events (ph "C"): one
+    counter track per program carrying mfu + the roofline gap terms,
+    plus a bytes track for the collective payload — the attribution as
+    a timeline series next to the spans it explains. The ledger is a
+    snapshot (atomically replaced each log window), so each export
+    carries one sample at the snapshot's wall stamp; Perfetto renders a
+    counter with the value held from that point."""
+    led = ledger_lib.read_ledger(run_dir)
+    if not led:
+        return []
+    t = _fnum(led.get("t"))
+    if t <= 0:
+        return []
+    events: List[dict] = []
+    for name, row in sorted((led.get("programs") or {}).items()):
+        if "mfu" not in row:
+            continue
+        series = {"mfu": row["mfu"],
+                  **{k: row.get(k, 0.0) for k in ledger_lib.GAP_TERMS},
+                  "padding_waste_frac": row.get("padding_waste_frac",
+                                                0.0)}
+        events.append({"ph": "C", "name": f"roofline {name}",
+                       "cat": "ledger", "t": t,
+                       "args": {k: round(_fnum(v), 6)
+                                for k, v in series.items()}})
+        if row.get("collective_bytes_per_step"):
+            events.append({"ph": "C", "name": f"collective_bytes {name}",
+                           "cat": "ledger", "t": t,
+                           "args": {"bytes_per_step": _fnum(
+                               row["collective_bytes_per_step"])}})
     return events
 
 
@@ -339,6 +374,9 @@ def collect_sources(d: str) -> List[Tuple[int, str, List[dict]]]:
         # untraced tune runs: the trial journal is the span source (the
         # attempts.jsonl pattern; an armed tune tracer wins)
         launcher_events.extend(_tune_trial_events(d))
+    # cost-ledger counter tracks (--cost_ledger runs) ride the launcher
+    # pid: one roofline series per program
+    launcher_events.extend(_ledger_events(d))
     beacons = _beacon_events(d)
     for rank, ev in beacons.items():
         rank_shards.setdefault(rank, []).append(ev)
@@ -378,6 +416,15 @@ def chrome_trace(d: str) -> dict:
                                  ("parent", "parent_id")):
                 if ev.get(key):
                     args[out_key] = ev[key]
+            if ev.get("ph") == "C":
+                # counter sample: args ARE the series values (numeric
+                # only — Perfetto draws one line per key)
+                trace_events.append({
+                    "name": str(ev.get("name", "?")), "cat": cat,
+                    "ph": "C", "pid": pid, "tid": tid_of[cat],
+                    "ts": round((t - base) * 1e6, 1),
+                    "args": {k: _fnum(v) for k, v in args.items()}})
+                continue
             ch = {"name": str(ev.get("name", "?")), "cat": cat,
                   "ph": "i" if ev.get("ph") == "i" else "X",
                   "pid": pid, "tid": tid_of[cat],
@@ -449,6 +496,24 @@ def _prom_run(p: _Prom, run_dir: str, now: float,
             p.add("dpt_goodput_seconds", agg[cat],
                   {**(labels or {}), "category": cat[:-2]},
                   help_="goodput ledger decomposition (seconds)")
+    led = ledger_lib.read_ledger(run_dir)
+    for name, row in sorted(((led or {}).get("programs") or {}).items()):
+        if "mfu" not in row:
+            continue
+        lab = {**(labels or {}), "program": name}
+        p.add("dpt_mfu", row["mfu"], lab,
+              help_="measured model-FLOPs utilization per program "
+                    "(perf_ledger.json)")
+        for term in ledger_lib.GAP_TERMS:
+            p.add("dpt_mfu_gap", row.get(term),
+                  {**lab, "component": term.replace("mfu_gap_", "")},
+                  help_="roofline MFU-gap decomposition "
+                        "(sums with dpt_mfu to 1)")
+        p.add("dpt_collective_bytes_per_step",
+              row.get("collective_bytes_per_step"), lab,
+              help_="HLO-tallied collective payload per step")
+        p.add("dpt_padding_waste_frac", row.get("padding_waste_frac"),
+              lab, help_="share of step tokens that are padding")
 
 
 def _prom_fleet(p: _Prom, fleet_dir: str, now: float) -> None:

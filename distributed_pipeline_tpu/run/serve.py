@@ -235,6 +235,10 @@ def _serve_single(settings: ServeSettings) -> dict:
         "wall_s": round(wall_s, 2),
     }
     result.update(server.prefix_stats())
+    if settings.cost_ledger:
+        # roofline attribution off the live executables (obs/ledger.py);
+        # n_devices=1: replicated decode, per-chip == service rate
+        result["ledger"] = server.cost_ledger(wall_s=wall_s, n_devices=1)
     if settings.sanitize:
         # steady-state growth past the warm snapshot must be 0: the two
         # phase executables compile exactly once, during warmup

@@ -26,6 +26,7 @@ from typing import Any, List, Optional
 
 from ..chaos import goodput
 from ..obs import export as export_lib
+from ..obs import ledger as ledger_lib
 
 __all__ = ["fleet_status", "main", "render", "run_status", "status"]
 
@@ -41,17 +42,30 @@ def _age(now: float, t: Any) -> Optional[float]:
 
 def run_status(run_dir: str, now: Optional[float] = None,
                stale_s: float = 10.0) -> dict:
-    """Training-run snapshot: one row per rank beacon + attempt summary +
-    the goodput fold so far."""
+    """Training-run snapshot: one row per rank beacon (latest step,
+    in-attempt steps/s, goodput) + attempt summary + the goodput fold —
+    and, when the run carries a perf ledger (``--cost_ledger``), the
+    train step's MFU with its roofline gap decomposition."""
     now = time.time() if now is None else now
     rows = []
     for rank, b in sorted(goodput.read_beacons(run_dir).items()):
         age = _age(now, b.get("t"))
         gp = b.get("goodput") if isinstance(b.get("goodput"), dict) else {}
+        # in-attempt rate from the beacon's own facts: steps this attempt
+        # advanced over its accounted wall (both written by the trainer)
+        sps = None
+        try:
+            advanced = int(b.get("step", 0)) - int(b.get("start_step", 0))
+            wall = float(gp.get("wall_s") or 0.0)
+            if advanced > 0 and wall > 0:
+                sps = round(advanced / wall, 4)
+        except (TypeError, ValueError):
+            pass
         rows.append({
             "rank": rank,
             "attempt": b.get("attempt"),
             "step": b.get("step"),
+            "steps_per_s": sps,
             "beacon_age_s": round(age, 1) if age is not None else None,
             "state": ("stale" if age is not None and age > stale_s
                       else "advancing"),
@@ -60,9 +74,11 @@ def run_status(run_dir: str, now: Optional[float] = None,
         })
     attempts = goodput.read_attempts(run_dir)
     agg = goodput.aggregate_run(run_dir) if (attempts or rows) else None
-    return {
+    snap = {
         "kind": "run",
         "dir": os.path.abspath(run_dir),
+        "step": max((r["step"] for r in rows
+                     if isinstance(r.get("step"), int)), default=None),
         "ranks": rows,
         "attempts": len(attempts),
         "last_rc": attempts[-1].get("rc") if attempts else None,
@@ -70,6 +86,17 @@ def run_status(run_dir: str, now: Optional[float] = None,
         "accounted_frac": (round(agg["accounted_frac"], 4) if agg
                            else None),
     }
+    led = ledger_lib.read_ledger(run_dir)
+    tr = (led or {}).get("programs", {}).get("train_step")
+    if tr and "mfu" in tr:
+        snap["mfu"] = round(tr["mfu"], 4)
+        snap["mfu_gaps"] = {k: round(tr.get(k, 0.0), 4)
+                            for k in ledger_lib.GAP_TERMS}
+        snap["collective_bytes_per_step"] = tr.get(
+            "collective_bytes_per_step")
+        snap["padding_waste_frac"] = round(
+            tr.get("padding_waste_frac", 0.0), 4)
+    return snap
 
 
 # ------------------------------------------------------------ serving fleet
@@ -158,13 +185,22 @@ def render(snap: dict) -> str:
             f"flight / {snap['replayed']} replayed   "
             f"ttft p50={snap['ttft_p50_s']}s p95={snap['ttft_p95_s']}s")
     else:
-        headers = ["rank", "state", "attempt", "step", "beacon_age_s",
-                   "goodput", "steady_recompiles"]
+        headers = ["rank", "state", "attempt", "step", "steps_per_s",
+                   "beacon_age_s", "goodput", "steady_recompiles"]
         out.append(_table(headers, [[r.get(h) for h in headers]
                                     for r in snap["ranks"]]))
         out.append(f"attempts: {snap['attempts']} (last rc "
                    f"{snap['last_rc']})   run goodput: {snap['goodput']} "
                    f"(accounted {snap['accounted_frac']})")
+        if snap.get("mfu") is not None:
+            gaps = snap.get("mfu_gaps") or {}
+            out.append(
+                f"mfu: {snap['mfu']}   gaps: "
+                + "  ".join(f"{k.replace('mfu_gap_', '')}="
+                            f"{gaps.get(k)}" for k in gaps)
+                + f"   collective_bytes/step: "
+                  f"{snap.get('collective_bytes_per_step')}"
+                  f"   padding_waste: {snap.get('padding_waste_frac')}")
     return "\n".join(out)
 
 

@@ -408,6 +408,9 @@ def main(namespace: argparse.Namespace) -> None:
         # armed at the launcher trace every attempt.
         trace=True if args.trace else None,
         profile_steps=args.profile_steps,
+        # Cost ledger (obs/ledger.py): roofline MFU-gap attribution per
+        # compiled program, logged each window + perf_ledger.json.
+        cost_ledger=args.cost_ledger,
     )
 
     # Exact-resume data order: fast-forward both streams so the continued

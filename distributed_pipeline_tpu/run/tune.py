@@ -54,6 +54,7 @@ def screen_for_workload(*, model_kwargs: Dict[str, Any], batch_size: int,
                         screen_only: bool = True,
                         final_rounds: int = 6, final_window_steps: int = 4,
                         child_timeout_s: float = 150.0,
+                        peak_bytes_ceiling: float = 0.0,
                         seed: int = 0,
                         tracer: Any = None,
                         echo: Callable[[str], None] = _echo,
@@ -135,7 +136,8 @@ def screen_for_workload(*, model_kwargs: Dict[str, Any], batch_size: int,
         global_microbatch=microbatch, measure_fn=measure_fn,
         pair_fn=pair_fn, journal_path=journal_path, budget_s=budget_s,
         screen_steps=screen_steps, screen_only=screen_only,
-        scope=family, tracer=tracer, echo=echo, clock=clock)
+        scope=family, peak_bytes_ceiling=peak_bytes_ceiling,
+        tracer=tracer, echo=echo, clock=clock)
     summary["family"] = family
     if artifact_path and summary.get("winner"):
         by_cid = {c.cid: c for c in cands}
@@ -199,6 +201,7 @@ def main(ns: argparse.Namespace) -> Dict[str, Any]:
                     final_rounds=settings.final_rounds,
                     final_window_steps=settings.final_window_steps,
                     child_timeout_s=settings.child_timeout_s,
+                    peak_bytes_ceiling=settings.peak_bytes_ceiling,
                     seed=settings.seed,
                     tracer=tracer)
     finally:

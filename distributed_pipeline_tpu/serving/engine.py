@@ -265,6 +265,13 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ plumbing
 
+    def executables(self) -> dict:
+        """The two AOT step wrappers keyed by phase name — the handles
+        the cost ledger (obs/ledger.py) extracts ``cost_analysis()``/
+        HLO text from (each wrapper's ``.compiled`` is None until its
+        first dispatch builds it)."""
+        return {"prefill": self._prefill_step, "decode": self._decode_step}
+
     def _put(self, x: np.ndarray) -> jax.Array:
         if self.mesh is not None:
             return jax.device_put(x, replicated(self.mesh))

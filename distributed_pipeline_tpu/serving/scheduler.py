@@ -39,7 +39,9 @@ from typing import Any, Deque, List, Optional
 import jax
 import numpy as np
 
-from ..utils.perf import EventStats, RecompileMonitor
+from ..utils.perf import EventStats, RecompileMonitor, device_peak_flops
+from ..utils.perf import transformer_decode_flops_per_token \
+    as decode_flops_per_token
 from .engine import DecodeEngine
 from .paged_kv import TRASH_PAGE, PageManager, PrefixCache
 
@@ -148,6 +150,15 @@ class DecodeServer:
         self.decode_steps = 0
         self.prefill_steps = 0
         self.tokens_fetched = 0
+        # Cost-ledger occupancy/padding counters: actual vs padded
+        # prompt tokens per prefill dispatch, and active vs compiled
+        # slot-steps per decode dispatch — the serving-side
+        # padding_waste_frac inputs (obs/ledger.py).
+        self.workload = workload
+        self.prompt_tokens_prefilled = 0
+        self.prefill_token_slots = 0
+        self.slot_steps_active = 0
+        self.slot_steps_total = 0
 
     # ----------------------------------------------------------- gauges etc.
 
@@ -186,10 +197,68 @@ class DecodeServer:
         self.decode_steps = 0
         self.prefill_steps = 0
         self.tokens_fetched = 0
+        self.prompt_tokens_prefilled = 0
+        self.prefill_token_slots = 0
+        self.slot_steps_active = 0
+        self.slot_steps_total = 0
 
     def prefix_stats(self) -> dict:
         """Prefix-cache gauges (empty dict when the cache is off)."""
         return self.prefix.stats() if self.prefix is not None else {}
+
+    def cost_ledger(self, *, wall_s: float, n_devices: int = 1) -> dict:
+        """Per-executable cost-ledger rows (obs/ledger.py) for the two
+        serving phases. The DECODE row carries the full roofline MFU-gap
+        attribution — tokens/s over ``wall_s`` against the forward-only
+        2N FLOPs/token roofline, slot-occupancy waste as its padding
+        term — while the PREFILL row carries the extraction plus the
+        prompt-padding waste (prefill runs at the compiled
+        [prefill_batch, max_prompt_len] shape regardless of actual
+        prompt lengths). ``n_devices`` defaults to 1: decode state is
+        replicated, so the service rate IS the per-chip rate (the
+        measure_decode rationale)."""
+        from ..obs import ledger as ledger_lib
+
+        n_params = self.workload.param_count(self.engine.params)
+        fpt = decode_flops_per_token(n_params)
+        device_kind = getattr(jax.devices()[0], "device_kind", "cpu")
+        rows: dict = {}
+        for name, aot in self.engine.executables().items():
+            if aot.compiled is None:
+                continue
+            row = {"program": f"serve_{name}",
+                   **ledger_lib.extract_cost(aot.compiled)}
+            if name == "decode":
+                tokens_per_s = (self.tokens_fetched / wall_s
+                                if wall_s > 0 else 0.0)
+                steps_per_s = (self.decode_steps / wall_s
+                               if wall_s > 0 else 0.0)
+                occupancy_waste = (
+                    1.0 - self.slot_steps_active / self.slot_steps_total
+                    if self.slot_steps_total > 0 else 0.0)
+                row.update({
+                    "flops_per_token": fpt,
+                    "n_params": n_params,
+                    "tokens_per_s": tokens_per_s,
+                    "steps_per_s": steps_per_s,
+                    "decode_span": self.engine.decode_span,
+                })
+                row.update(ledger_lib.roofline_attribution(
+                    tokens_per_s=tokens_per_s, flops_per_token=fpt,
+                    peak_flops=device_peak_flops(), n_devices=n_devices,
+                    steps_per_s=steps_per_s,
+                    collective_bytes_per_step=row.get(
+                        "collective_bytes_per_step", 0.0),
+                    bytes_accessed=row.get("bytes_accessed", 0.0),
+                    device_kind=device_kind,
+                    padding_waste_frac=occupancy_waste))
+            else:
+                row["padding_waste_frac"] = (
+                    1.0 - self.prompt_tokens_prefilled
+                    / self.prefill_token_slots
+                    if self.prefill_token_slots > 0 else 0.0)
+            rows[f"serve_{name}"] = row
+        return rows
 
     # ------------------------------------------------------------ lifecycle
 
@@ -307,6 +376,10 @@ class DecodeServer:
             stables[i] = self.block_tables[slot]
         toks = self.engine.prefill(ids, lens, smap, stables)
         self.prefill_steps += 1
+        # padding accounting: actual prompt tokens vs the padded
+        # [prefill_batch, max_prompt_len] shape the executable ran at
+        self.prompt_tokens_prefilled += int(lens.sum())
+        self.prefill_token_slots += bp * lp
         self._ring.append((toks, list(batch)))
         # a budget-1 request is already complete at dispatch level
         for slot, _ in batch:
@@ -345,6 +418,11 @@ class DecodeServer:
             toks = self.engine.decode()
             span = self.engine.decode_span
             self.decode_steps += 1
+            # occupancy accounting: active vs compiled slot-steps this
+            # dispatch (inactive slots run anyway, writing to trash —
+            # the decode-side padding waste)
+            self.slot_steps_active += int(self.active.sum()) * span
+            self.slot_steps_total += len(self.slots) * span
             self._ring.append((toks, snap))
             for s, _ in snap:
                 st = self.slots[s]

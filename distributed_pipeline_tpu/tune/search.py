@@ -35,7 +35,8 @@ from ..chaos.goodput import read_journal
 from ..obs import trace as trace_lib
 from .candidates import Candidate, validate_candidate
 
-__all__ = ["append_journal", "read_trials", "run_search", "write_artifact"]
+__all__ = ["append_journal", "over_ceiling", "read_trials", "run_search",
+           "write_artifact"]
 
 
 def append_journal(path: str, row: dict) -> None:
@@ -61,6 +62,22 @@ def _rate(row: dict) -> float:
         return 0.0
 
 
+def over_ceiling(row: dict, peak_bytes_ceiling: float) -> bool:
+    """Whether a measured trial row exceeds the memory-headroom ceiling.
+    Judged from the CURRENT ceiling at ranking time (not the status
+    recorded at measure time), so a resumed tune under a different
+    ceiling re-ranks replayed rows instead of trusting a stale verdict.
+    A row that never measured ``peak_live_bytes`` (CPU children report
+    0 — the backend has no memory stats) can never be over-ceiling."""
+    if peak_bytes_ceiling <= 0:
+        return False
+    res = row.get("result") or {}
+    try:
+        return float(res.get("peak_live_bytes") or 0.0) > peak_bytes_ceiling
+    except (TypeError, ValueError):
+        return False
+
+
 def run_search(*, candidates: List[Candidate],
                shapes: Dict[str, Tuple[int, ...]],
                n_devices: int,
@@ -75,6 +92,7 @@ def run_search(*, candidates: List[Candidate],
                screen_only: bool = False,
                max_rungs: int = 4,
                scope: str = "",
+               peak_bytes_ceiling: float = 0.0,
                tracer: Any = trace_lib.NULL,
                echo: Callable[[str], None] = lambda s: None,
                clock: Callable[[], float] = time.monotonic
@@ -83,7 +101,16 @@ def run_search(*, candidates: List[Candidate],
     baseline). ``measure_fn(cand, steps)`` and ``pair_fn(a, b)`` return
     child result rows (an ``{"error": ...}`` row prunes, never raises);
     injecting fakes of both (plus ``clock``) is how the tests pin
-    determinism and budget behavior without spawning children."""
+    determinism and budget behavior without spawning children.
+
+    ``peak_bytes_ceiling`` > 0 arms the memory-headroom objective (the
+    r15 NOTE's unwired ranking input): a candidate whose measured
+    ``peak_live_bytes`` exceeds the ceiling is RANKED OUT — journaled
+    with status ``over_ceiling`` (its measurement is kept: a later tune
+    with a higher ceiling replays it), counted in its own accounting
+    bucket, and never a winner — the xl presets' path onto bigger
+    meshes, where the fastest layout that does not fit is not a
+    layout."""
     t0 = clock()
     prior: Dict[Tuple[str, int, str], dict] = {}
     for row in read_trials(journal_path):
@@ -116,7 +143,7 @@ def run_search(*, candidates: List[Candidate],
         return row, False
 
     counts = {"enumerated": len(candidates), "rejected": 0, "measured": 0,
-              "pruned": 0, "skipped": 0}
+              "pruned": 0, "skipped": 0, "over_ceiling": 0}
 
     # ---------------------------------------------------- static rejection
     valid: List[Candidate] = []
@@ -157,7 +184,14 @@ def run_search(*, candidates: List[Candidate],
         w = trace_lib.Stopwatch()
         res = measure_fn(cand, steps)
         dur = w.lap_s()
-        status = "pruned" if "error" in res else "measured"
+        if "error" in res:
+            status = "pruned"
+        elif over_ceiling({"result": res}, peak_bytes_ceiling):
+            # measurement kept (a later tune with a higher ceiling
+            # replays it); the status records the verdict at measure time
+            status = "over_ceiling"
+        else:
+            status = "measured"
         row = {"kind": "trial", "rung": rung, "cid": cand.cid,
                "status": status, "t": round(time.time(), 3),
                "dur_s": round(dur, 3), "result": res}
@@ -169,7 +203,7 @@ def run_search(*, candidates: List[Candidate],
                                   "status": status,
                                   "steps_per_s": _rate(row) or None})
         echo(f"# tune: rung {rung} {cand.cid}: {status}"
-             + (f" {_rate(row):.4f} steps/s" if status == "measured"
+             + (f" {_rate(row):.4f} steps/s" if status != "pruned"
                 else f" ({res.get('error', '')[:120]})"))
         return row
 
@@ -180,14 +214,25 @@ def run_search(*, candidates: List[Candidate],
         if row is None:
             counts["skipped"] += 1
             continue
-        # run_trial only ever returns measured/pruned rows: a prior run's
-        # skipped row is retried (not replayed) and a fresh budget skip
-        # returns None, counted above
-        if row.get("status") == "measured":
-            counts["measured"] += 1
-            measured.append((cand, row))
+        # run_trial only ever returns measured/over_ceiling/pruned rows:
+        # a prior run's skipped row is retried (not replayed) and a
+        # fresh budget skip returns None, counted above
+        if row.get("status") in ("measured", "over_ceiling"):
             if cand.is_baseline:
+                # reference rate even when the hand-tuned table itself
+                # busts the ceiling (then there may honestly be no
+                # winner under it)
                 baseline_row = row
+            # the ceiling verdict is recomputed against the CURRENT
+            # ceiling (a replayed row's recorded status may predate it)
+            if over_ceiling(row, peak_bytes_ceiling):
+                counts["over_ceiling"] += 1
+                echo(f"# tune: {cand.cid} ranked out: peak_live_bytes "
+                     f"{(row.get('result') or {}).get('peak_live_bytes')}"
+                     f" > ceiling {peak_bytes_ceiling:.0f}")
+            else:
+                counts["measured"] += 1
+                measured.append((cand, row))
         else:
             counts["pruned"] += 1
 
@@ -214,9 +259,11 @@ def run_search(*, candidates: List[Candidate],
                 # budget ran out mid-rung: keep the candidate at its
                 # previous-rung rate rather than dropping a survivor
                 next_round.append((cand, prev))
-            elif row.get("status") == "measured":
+            elif (row.get("status") in ("measured", "over_ceiling")
+                  and not over_ceiling(row, peak_bytes_ceiling)):
                 next_round.append((cand, row))
-            # pruned at the longer horizon: drops out of the ranking
+            # pruned at the longer horizon (or over the memory ceiling
+            # at the bigger measured footprint): drops out of the ranking
         survivors = rank(next_round)
         rung, steps = rung + 1, steps * 2
 
@@ -256,7 +303,8 @@ def run_search(*, candidates: List[Candidate],
 
     # ------------------------------------------------------------ summary
     accounted = (counts["rejected"] + counts["measured"]
-                 + counts["pruned"] + counts["skipped"])
+                 + counts["pruned"] + counts["skipped"]
+                 + counts["over_ceiling"])
     summary: Dict[str, Any] = {
         "n_devices": n_devices,
         "counts": counts,
@@ -265,6 +313,8 @@ def run_search(*, candidates: List[Candidate],
         "baseline_steps_per_s": (_rate(baseline_row)
                                  if baseline_row else None),
     }
+    if peak_bytes_ceiling > 0:
+        summary["peak_bytes_ceiling"] = peak_bytes_ceiling
     if winner is not None:
         win_res = (winner_row or {}).get("result") or {}
         if "a" in win_res or "b" in win_res:  # finals row: pick the arm,

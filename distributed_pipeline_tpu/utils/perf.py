@@ -17,6 +17,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 
 __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
+           "transformer_decode_flops_per_token", "active_param_count",
            "StepTimer", "mfu", "enable_persistent_compilation_cache",
            "timed_lower_compile", "AOTStep", "RecompileMonitor",
            "StallBreakdown", "EventStats", "GoodputTracker",
@@ -54,10 +55,50 @@ def transformer_train_flops_per_token(n_params: int, n_layers: int,
     return 6.0 * n_params + 12.0 * n_layers * hidden * seq_len
 
 
+def transformer_decode_flops_per_token(n_params: int) -> float:
+    """Forward-only FLOPs per DECODED token: the 2N weight-matmul term
+    (each param participates in one multiply-add). The per-token
+    attention share during cached decode is position-dependent and small
+    next to the weight streaming that actually bounds decode — the 2N
+    figure is the standard serving roofline numerator."""
+    return 2.0 * n_params
+
+
 def mfu(tokens_per_sec: float, flops_per_token: float,
         n_devices: Optional[int] = None) -> float:
     n = n_devices if n_devices is not None else jax.device_count()
     return tokens_per_sec * flops_per_token / (device_peak_flops() * n)
+
+
+def active_param_count(params: Any, n_params: int, *, moe_experts: int = 0,
+                       moe_top_k: int = 2) -> int:
+    """Params ACTIVE per token: a top-k routed MoE block only runs top_k
+    of its ``moe_experts`` expert MLPs, so counting every expert's
+    weights would overstate the model FLOPs. Inactive mass is derived
+    from the actual expert weight shapes (leading dim == moe_experts
+    under a "moe" module — or dim 1 under a scan-group stack) so it
+    tracks models/moe.py by construction. Dense models (or top_k >=
+    experts) return ``n_params`` unchanged. One owner for the FLOPs-side
+    param accounting (graftlint GL010): MFU numerators derive from THIS
+    count, here or in obs/ledger.py."""
+    if moe_experts <= moe_top_k:
+        return n_params
+    import numpy as np
+    from jax.tree_util import tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(params)
+    # expert dim position differs by layout: named blocks stack experts
+    # on dim 0 ([experts, ...]); MoEScanBlocks prepends a scan-group dim
+    # ([groups, experts, ...]) — accept either.
+    expert_params = sum(
+        int(np.prod(leaf.shape))
+        for path, leaf in leaves
+        if any("moe" in str(getattr(k, "key", k)) for k in path)
+        and leaf.ndim >= 2
+        and (leaf.shape[0] == moe_experts
+             or (leaf.ndim >= 3 and leaf.shape[1] == moe_experts)))
+    return n_params - round(expert_params
+                            * (moe_experts - moe_top_k) / moe_experts)
 
 
 def tree_bytes(tree: Any) -> int:
@@ -200,6 +241,14 @@ class AOTStep:
         self._sig: Any = None
         self._pin = pin_signature
         self.compile_time_s = 0.0
+
+    @property
+    def compiled(self) -> Any:
+        """The live compiled executable (``jax.stages.Compiled``), or
+        None before the first call builds it — the handle the cost
+        ledger (obs/ledger.py) extracts ``cost_analysis()``/
+        ``memory_analysis()``/HLO text from."""
+        return self._compiled
 
     @staticmethod
     def _signature(args: Any) -> Any:

@@ -47,6 +47,7 @@ from jax.sharding import Mesh
 
 from ..data.device_prefetch import DeviceBatch, prefetch_to_device
 from ..models import Workload
+from ..obs import ledger as ledger_lib
 from ..obs import trace as trace_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition as partition_lib
@@ -130,6 +131,7 @@ class TrainLoop:
         partition_rules: Optional[Sequence[Tuple[str, Any]]] = None,
         trace: Optional[bool] = None,
         profile_steps: str = "",
+        cost_ledger: bool = False,
     ) -> None:
         # Time-to-signal accounting starts at construction: everything up
         # to the end of the first optimizer step (state init, restore,
@@ -199,6 +201,28 @@ class TrainLoop:
         # must stay untraced even under DPT_TRACE), None defers to the
         # env (how launcher-supervised rings arm without a CLI flag)
         self._trace = trace
+
+        # Cost ledger (obs/ledger.py): per-compiled-program FLOPs/bytes/
+        # collective extraction + the roofline MFU-gap attribution row,
+        # logged each log window and snapshotted to
+        # <run_dir>/perf_ledger.json. Off by default: extraction is a
+        # one-time HLO walk but the padding meter touches every batch.
+        self.cost_ledger = cost_ledger
+        self.padding = ledger_lib.PaddingMeter() if cost_ledger else None
+        # measured steady rate anchor, armed at first-step completion:
+        # (steps since, seconds since, stall sums since) excludes the
+        # compile-bearing first step, the same boundary
+        # steady_recompile_count uses
+        self._ledger_watch: Optional[trace_lib.Stopwatch] = None
+        self._ledger_step0 = 0
+        self._ledger_stall0: Dict[str, float] = {}
+        # extraction cache: cost_analysis + the HLO walk are immutable
+        # per compiled executable, and as_text() on a real model is a
+        # multi-second serialization — paying it once per log window
+        # would inflate the very mfu_gap_host the ledger reports.
+        # Keyed by executable identity so an AOTStep shape-change
+        # recompile invalidates naturally.
+        self._ledger_cost_cache: Dict[str, Tuple[Any, Dict[str, Any]]] = {}
 
         # Steady-state throughput layer (ISSUE 5): keep the device queue
         # full. prefetch_depth > 0 wraps the data iterator so batches are
@@ -707,6 +731,13 @@ class TrainLoop:
 
     def _prepare(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         """Host batch [B, ...] -> global sharded [n_micro, B_micro_global, ...]."""
+        if self.padding is not None and "pad_mask" in batch:
+            # active-token accounting off the mask the data path already
+            # carries — the padding_waste_frac side of the cost ledger.
+            # np.sum on the host batch; thread-safe (the prefetch wrapper
+            # calls _prepare from its own thread).
+            pm = batch["pad_mask"]
+            self.padding.add(int(pm.sum()), int(pm.size))
         mb = self.microbatch
         reshaped = {k: v.reshape((self.n_micro, mb) + v.shape[1:])
                     for k, v in batch.items()}
@@ -784,6 +815,13 @@ class TrainLoop:
             # are silent retraces — the gauge that must stay frozen on a
             # warm-cache resume (the chaos bench acceptance).
             self._recompiles_at_first_step = self._recompiles.count
+            # Ledger rate anchor: tokens/s and per-step stall means
+            # measured from here on cover only steady steps (the first
+            # step's dispatch_s carries the whole AOT compile, which
+            # would swamp a mean taken from step 0).
+            self._ledger_watch = trace_lib.Stopwatch()
+            self._ledger_step0 = self.step + 1
+            self._ledger_stall0 = self.stalls.sums()
         self.step += 1
         self._samples += n_items * jax.process_count()
         self._timer.tick()
@@ -967,6 +1005,89 @@ class TrainLoop:
             "peak_live_bytes": peak_live_bytes(),
         }
 
+    # ------------------------------------------------------- cost ledger
+
+    def ledger_rows(self) -> Dict[str, Dict[str, Any]]:
+        """Per-compiled-program cost-ledger rows (obs/ledger.py): XLA's
+        own FLOPs/bytes accounting + the HLO collective tally off the
+        AOT executables this loop already holds, folded with the
+        analytic ``flops_per_token``, the measured steady tokens/s, and
+        the stall gauges into the roofline MFU-gap attribution. The
+        train row reuses the EXACT stall/goodput seconds the ledger
+        elsewhere reports (``data_stall_s_total`` is the same expression
+        ``goodput_summary`` folds), so the two can never disagree."""
+        rows: Dict[str, Dict[str, Any]] = {}
+        tokens_per_step = self.global_batch * self.workload.seq_len
+        steps_per_s = 0.0
+        n_steady = 0
+        if self._ledger_watch is not None:
+            n_steady = self.step - self._ledger_step0
+            dt = self._ledger_watch.peek_s()
+            if n_steady > 0 and dt > 0:
+                steps_per_s = n_steady / dt
+        # steady-window per-step stall means (sums since the first-step
+        # anchor / steady steps): the cumulative means would fold the
+        # first step's compile-bearing dispatch into every attribution
+        sums = self.stalls.sums()
+        steady = {g: (max(0.0, s - self._ledger_stall0.get(g, 0.0))
+                      / n_steady if n_steady > 0 else 0.0)
+                  for g, s in sums.items()}
+        host_stall = (steady["data_wait_s"] + steady["h2d_wait_s"]
+                      + steady["dispatch_s"])
+        device_kind = getattr(jax.devices()[0], "device_kind", "cpu")
+        for name, aot in (("train_step", self._train_step),
+                          ("eval_step", self._eval_step)):
+            if aot.compiled is None:
+                continue
+            cached = self._ledger_cost_cache.get(name)
+            if cached is None or cached[0] is not aot.compiled:
+                cached = (aot.compiled,
+                          ledger_lib.extract_cost(aot.compiled))
+                self._ledger_cost_cache[name] = cached
+            row: Dict[str, Any] = {"program": name, **cached[1]}
+            if name == "train_step":
+                row.update({
+                    "tokens_per_step": tokens_per_step,
+                    "flops_per_token": self._flops_per_token,
+                    "analytic_flops_per_step":
+                        self._flops_per_token * tokens_per_step,
+                    "steps_per_s": steps_per_s,
+                    "tokens_per_s": steps_per_s * tokens_per_step,
+                    "device_step_s": steady["device_step_s"],
+                    "host_stall_s_per_step": host_stall,
+                    # goodput-identity fields: the SAME cumulative sums
+                    # the goodput summary folds as data_stall_s
+                    "data_stall_s_total": self._stall_sum(),
+                })
+                row.update(ledger_lib.roofline_attribution(
+                    tokens_per_s=row["tokens_per_s"],
+                    flops_per_token=self._flops_per_token,
+                    peak_flops=device_peak_flops(),
+                    n_devices=jax.device_count(),
+                    steps_per_s=steps_per_s,
+                    collective_bytes_per_step=row.get(
+                        "collective_bytes_per_step", 0.0),
+                    bytes_accessed=row.get("bytes_accessed", 0.0),
+                    host_stall_s_per_step=host_stall,
+                    device_kind=device_kind,
+                    padding_waste_frac=(self.padding.frac
+                                        if self.padding is not None
+                                        else 0.0)))
+            rows[name] = row
+        return rows
+
+    def _write_ledger_snapshot(self,
+                               rows: Dict[str, Dict[str, Any]]) -> None:
+        if not rows or not self.checkpoint_dir \
+                or "://" in self.checkpoint_dir:
+            return
+        ledger_lib.write_ledger(
+            self.checkpoint_dir, rows, t=time.time(),
+            extra={"step": self.step,
+                   "n_devices": jax.device_count(),
+                   "device_kind": getattr(jax.devices()[0],
+                                          "device_kind", "cpu")})
+
     def _log_throughput(self) -> None:
         sps, tps = self._timer.lap()
         if tps > 0:
@@ -988,6 +1109,21 @@ class TrainLoop:
         # ZeRO-1 acceptance gauge) + backend peak live bytes.
         for gauge, b in self.footprint().items():
             logger.logkv(gauge, b)
+        # Cost ledger (--cost_ledger): the train step's roofline MFU-gap
+        # decomposition rides the same cadence, and the run-dir
+        # perf_ledger.json snapshot refreshes (atomic replace) so
+        # status/export read a live attribution, not only a post-mortem.
+        if self.cost_ledger:
+            rows = self.ledger_rows()
+            tr = rows.get("train_step")
+            if tr:
+                for gauge in ledger_lib.GAP_TERMS:
+                    logger.logkv(gauge, round(tr[gauge], 4))
+                logger.logkv("collective_bytes_per_step",
+                             tr["collective_bytes_per_step"])
+                logger.logkv("padding_waste_frac",
+                             round(tr["padding_waste_frac"], 4))
+            self._write_ledger_snapshot(rows)
 
     def _maybe_profile(self, loop_step: int) -> None:
         """Start/stop the jax.profiler trace window (steps counted from loop
@@ -1072,6 +1208,9 @@ class TrainLoop:
         logger.logkvs({f"goodput_{k}" if k != "goodput" else k:
                        round(v, 4) for k, v in summary.items()})
         self._write_goodput_record()
+        if self.cost_ledger:
+            # final ledger snapshot: the attribution the run ends on
+            self._write_ledger_snapshot(self.ledger_rows())
         self.tracer.close()
 
     __call__ = run_loop  # reference trainer.py:357

@@ -36,11 +36,18 @@ def bench_run(tmp_path_factory):
     tests: BENCH_BUDGET_S=1 forces every leg after the headline to be
     budget-skipped (the headline is exempt by contract)."""
     tmp = tmp_path_factory.mktemp("bench")
+    # Pre-seed the HISTORY with a prior run's row: the append contract
+    # (ISSUE 14) says bench extends the time series, never truncates it.
+    history = tmp / "history.jsonl"
+    history.write_text(json.dumps(
+        {"name": "diffuseq-base-seq128", "tokens_per_sec_per_chip": 1.0,
+         "run_id": "prior-run", "t": 0.0}) + "\n")
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
         "BENCH_BUDGET_S": "1",
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_HISTORY": str(history),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
         # glob: the headline + its satellite twins — enough legs to
         # observe ordering and skipping without a multi-minute test
@@ -54,11 +61,11 @@ def bench_run(tmp_path_factory):
     proc = subprocess.run(
         [sys.executable, "bench.py"], cwd=REPO, env=env,
         capture_output=True, text=True, timeout=420)
-    return proc, tmp / "legs.jsonl"
+    return proc, tmp / "legs.jsonl", history
 
 
 def test_bench_budget_exits_zero_with_parseable_json(bench_run):
-    proc, _ = bench_run
+    proc, _, _ = bench_run
     assert proc.returncode == 0, proc.stderr[-2000:]
     final = json.loads(proc.stdout.strip().splitlines()[-1])
     assert final["configs"], final
@@ -66,7 +73,7 @@ def test_bench_budget_exits_zero_with_parseable_json(bench_run):
 
 
 def test_bench_headline_leg_completes_first(bench_run):
-    proc, _ = bench_run
+    proc, _, _ = bench_run
     final = json.loads(proc.stdout.strip().splitlines()[-1])
     head = final["configs"][0]
     # The headline leg is exempt from the budget guard: it carries real
@@ -81,7 +88,7 @@ def test_bench_headline_leg_completes_first(bench_run):
 
 
 def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
-    proc, _ = bench_run
+    proc, _, _ = bench_run
     final = json.loads(proc.stdout.strip().splitlines()[-1])
     skipped = [c for c in final["configs"] if c.get("skipped") == "budget"]
     assert skipped, "1s budget must skip every non-headline leg"
@@ -93,13 +100,85 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
-    proc, artifact = bench_run
+    proc, artifact, _ = bench_run
     final = json.loads(proc.stdout.strip().splitlines()[-1])
     rows = [json.loads(line) for line in
             artifact.read_text().strip().splitlines()]
     # the incrementally-persisted artifact IS the final configs list — a
     # timeout after leg k would still have left rows 0..k on disk
     assert rows == final["configs"]
+
+
+def test_bench_headline_row_carries_the_cost_ledger(bench_run):
+    """ISSUE 14 acceptance: the headline train row carries a POPULATED
+    ledger — collective_bytes_per_step present, mfu_gap_* summing with
+    the (unrounded) mfu to exactly 1 (residual-by-construction, 1e-6),
+    padding waste inside [0, 1]."""
+    from distributed_pipeline_tpu.obs import ledger as ledger_lib
+
+    proc, _, _ = bench_run
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    head = final["configs"][0]
+    assert "collective_bytes_per_step" in head
+    for term in ledger_lib.GAP_TERMS:
+        assert term in head and head[term] >= 0
+    assert abs(ledger_lib.gap_sum_identity(head) - 1.0) < 1e-6
+    assert 0 <= head["padding_waste_frac"] <= 1
+    assert head["flops_per_execution"] > 0
+    assert head["bytes_accessed"] > 0
+
+
+def test_bench_history_appends_without_truncating(bench_run):
+    """The bench_history.jsonl contract (ISSUE 14): bench APPENDS every
+    leg row stamped with one run_id per invocation — the pre-seeded
+    prior run's row survives, the new rows share a fresh id, and the
+    sentinel's grouping sees two runs in file order."""
+    from distributed_pipeline_tpu.chaos.goodput import read_journal
+    from distributed_pipeline_tpu.obs import regress as regress_lib
+
+    proc, artifact, history = bench_run
+    rows = read_journal(str(history))
+    assert rows[0]["run_id"] == "prior-run", "history was truncated"
+    new = [r for r in rows if r.get("run_id") != "prior-run"]
+    artifact_rows = [json.loads(l) for l in
+                     artifact.read_text().strip().splitlines()]
+    assert len(new) == len(artifact_rows)
+    assert len({r["run_id"] for r in new}) == 1  # one id per invocation
+    assert all("t" in r for r in new)
+    runs = regress_lib.group_runs(rows)
+    assert len(runs) == 2 and runs[0][0] == "prior-run"
+
+
+@pytest.mark.lint
+def test_regress_sentinel_exits_nonzero_on_injected_regression(tmp_path):
+    """CI wiring (ISSUE 14): ``python -m distributed_pipeline_tpu.obs.
+    regress`` must exit nonzero when the newest recorded run regresses
+    past the band, and zero on a flat history — the property a CI job
+    gates on."""
+    def rows(tps3):
+        return [json.dumps({"name": "diffuseq-base-seq128",
+                            "tokens_per_sec_per_chip": tps,
+                            "mfu": 0.5, "peak_live_bytes": 100,
+                            "recompile_count": 0, "run_id": f"r{i}",
+                            "t": 1.0})
+                for i, tps in enumerate([1000, 1005, tps3], 1)]
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    flat, reg = tmp_path / "flat.jsonl", tmp_path / "reg.jsonl"
+    flat.write_text("\n".join(rows(1002)) + "\n")
+    reg.write_text("\n".join(rows(900)) + "\n")
+    base = [sys.executable, "-m", "distributed_pipeline_tpu.obs.regress",
+            "--history"]
+    ok = subprocess.run(base + [str(flat)], capture_output=True,
+                        text=True, env=env, cwd=REPO)
+    assert ok.returncode == 0, ok.stderr
+    assert json.loads(ok.stdout)["verdict"] == "ok"
+    bad = subprocess.run(base + [str(reg)], capture_output=True,
+                         text=True, env=env, cwd=REPO)
+    assert bad.returncode == 1, (bad.returncode, bad.stderr)
+    assert json.loads(bad.stdout)["verdict"] == "regressed"
+    assert "regressed" in bad.stderr  # the human table names the leg
 
 
 def test_bench_only_exact_match_with_optional_glob():
@@ -151,6 +230,7 @@ def serve_bench_run(tmp_path_factory):
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
         "BENCH_ONLY": "*serve-decode*",
+        "BENCH_HISTORY": "",
     })
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
@@ -169,6 +249,8 @@ def test_serve_bench_legs_land_parsed_rows(serve_bench_run):
     rows = {r["name"]: r for r in
             (json.loads(line) for line in
              artifact.read_text().strip().splitlines())}
+    from distributed_pipeline_tpu.obs import ledger as ledger_lib
+
     for slots in (1, 8, 64):
         row = rows[f"gpt2-serve-decode-b{slots}"]
         assert "error" not in row and "skipped" not in row, row
@@ -179,6 +261,13 @@ def test_serve_bench_legs_land_parsed_rows(serve_bench_run):
         assert row["compile_s"] > 0
         assert row["recompile_count"] == 0, (
             "steady-state serving recompiled", row)
+        # ISSUE 14 acceptance (b8 named explicitly): serve rows carry a
+        # populated decode ledger with the exact gap-sum identity and
+        # steady recompiles still 0
+        assert "collective_bytes_per_step" in row
+        assert abs(ledger_lib.gap_sum_identity(row) - 1.0) < 1e-6
+        assert 0 <= row["padding_waste_frac"] <= 1
+        assert 0 <= row["prefill_padding_waste_frac"] <= 1
 
 
 def test_serve_bench_final_json_carries_rows(serve_bench_run):
@@ -214,6 +303,7 @@ def fleet_bench_run(tmp_path_factory):
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
         "BENCH_ONLY": "gpt2-serve-fleet-chaos",
+        "BENCH_HISTORY": "",
     })
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
@@ -261,6 +351,7 @@ def tune_bench_run(tmp_path_factory):
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
         "BENCH_ONLY": "diffuseq-base-seq128-tune",
+        "BENCH_HISTORY": "",
     })
     env.pop("XLA_FLAGS", None)
     env.pop("DPT_TUNE_INJECT", None)
@@ -306,6 +397,7 @@ def trace_bench_run(tmp_path_factory):
         "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
         "BENCH_CACHE_DIR": str(tmp / "cache"),
         "BENCH_ONLY": "diffuseq-base-seq128-trace",
+        "BENCH_HISTORY": "",
     })
     env.pop("XLA_FLAGS", None)
     env.pop("DPT_TRACE", None)  # the leg arms its ON arm itself

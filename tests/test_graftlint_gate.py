@@ -44,6 +44,9 @@ GATED_PATHS = [
     # the auto-tuner tests drive measurement TrainLoops (GL007) and
     # handle rule tables / spec trees directly (GL008 territory)
     os.path.join(ROOT, "tests", "test_tune.py"),
+    # the cost-ledger tests drive TrainLoop/DecodeServer outer loops
+    # (GL007) and are exactly where inline FLOPs math would breed (GL010)
+    os.path.join(ROOT, "tests", "test_ledger.py"),
 ]
 
 

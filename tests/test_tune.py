@@ -387,11 +387,56 @@ def test_tune_settings_roundtrip():
 
     s = TuneSettings.from_argv(["--family", "gpt2", "--n_devices", "4",
                                 "--screen_only", "true",
-                                "--budget_s", "33"])
+                                "--budget_s", "33",
+                                "--peak_bytes_ceiling", "1e9"])
     assert (s.family, s.n_devices, s.screen_only, s.budget_s) == \
         ("gpt2", 4, True, 33.0)
+    assert s.peak_bytes_ceiling == 1e9
     s2 = TuneSettings.model_validate(json.loads(s.to_json()))
     assert s2 == s
+
+
+def test_peak_bytes_ceiling_ranks_out_with_closed_accounting(
+        tmp_path, tiny_rules, tiny_shapes):
+    """The memory-headroom objective (ISSUE 14 satellite; the r15 NOTE's
+    unwired ranking input): candidates whose measured peak_live_bytes
+    exceed --peak_bytes_ceiling are journaled as over_ceiling and never
+    win — even when they are the fastest — and the accounting invariant
+    extends to close over the new bucket. A replayed over-ceiling row
+    re-ranks under the CURRENT ceiling, so a later tune with more
+    headroom reuses the measurement instead of re-spawning a child."""
+    def measure(cand, steps):
+        base = _fake_measure()(cand, steps)
+        # the FASTEST candidates (zero1 arms) also have the biggest
+        # footprint: the ceiling must beat raw speed ranking
+        base["peak_live_bytes"] = 5_000 if cand.shard_optimizer else 100
+        return base
+
+    s, jp = _run(tmp_path, tiny_rules, tiny_shapes, name="ceil.jsonl",
+                 measure_fn=measure, screen_only=True,
+                 peak_bytes_ceiling=1_000.0)
+    c = s["counts"]
+    assert c["over_ceiling"] > 0
+    assert (c["rejected"] + c["measured"] + c["pruned"] + c["skipped"]
+            + c["over_ceiling"]) == c["enumerated"] == s["accounted"]
+    assert s["peak_bytes_ceiling"] == 1_000.0
+    # without the ceiling the zero1 arm wins (fastest fake rate); with it
+    # the winner must be a within-ceiling candidate
+    assert s["winner"] is not None
+    assert not s["winner"]["shard_optimizer"]
+    rows = search_lib.read_trials(jp)
+    over = [r for r in rows if r.get("status") == "over_ceiling"]
+    assert over and all(
+        (r["result"] or {}).get("peak_live_bytes", 0) > 1_000
+        for r in over)
+    # resume under a HIGHER ceiling: replayed rows re-rank, no re-measures
+    calls = []
+    s2, _ = _run(tmp_path, tiny_rules, tiny_shapes, name="ceil.jsonl",
+                 measure_fn=_fake_measure(calls), screen_only=True,
+                 peak_bytes_ceiling=1e9)
+    assert not calls, "resume must replay the journal, not re-measure"
+    assert s2["counts"]["over_ceiling"] == 0
+    assert s2["winner"]["shard_optimizer"]  # the fast arm wins again
 
 
 # ------------------------------------------------- export fold (obs/)
