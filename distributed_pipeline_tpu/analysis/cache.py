@@ -1,0 +1,125 @@
+"""Content-hash parse/summary cache for the analysis CLI and lint gate.
+
+The gated path list grows every PR; reparsing ~70 unchanged modules per
+``pytest -m lint`` run is pure waste. The cache memoizes exactly the
+per-file work — the AST parse, the per-module rule findings, and the
+:class:`~callgraph.ModuleSummary` the whole-program pass consumes — keyed
+on the file's content sha. The cross-module findings are *never* cached:
+they are recomputed from the (cached or fresh) summaries every run, so a
+change in module A still updates the findings it causes in module B.
+
+Soundness levers:
+
+* entries key on the file's **content sha** (not mtime — a ``git
+  checkout`` that restores bytes restores the hit);
+* the whole cache keys on a **salt** hashed over the analysis package's
+  own sources, so editing any rule or the summarizer invalidates every
+  entry at once (a stale summary schema can never be half-loaded);
+* an entry records the **rule codes** it was computed with; a run with a
+  narrower ``--rules`` selection may read it (findings are filtered),
+  but a run selecting rules the entry never ran misses.
+
+The file lives beside the baseline (``graftlint_cache.json``), is
+written atomically, and any unreadable/garbled state degrades to a cold
+cache — the cache can slow a run down, never corrupt it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+__all__ = ["AnalysisCache", "CACHE_NAME", "package_salt"]
+
+CACHE_NAME = "graftlint_cache.json"
+_VERSION = 1
+_salt: Optional[str] = None
+
+
+def package_salt() -> str:
+    """sha over this package's own .py sources: any change to the
+    analyzer invalidates every cached entry."""
+    global _salt
+    if _salt is None:
+        h = hashlib.sha1()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        for name in sorted(os.listdir(pkg)):
+            if not name.endswith(".py"):
+                continue
+            h.update(name.encode())
+            try:
+                with open(os.path.join(pkg, name), "rb") as f:
+                    h.update(f.read())
+            except OSError:  # pragma: no cover - defensive
+                pass
+        _salt = h.hexdigest()
+    return _salt
+
+
+class AnalysisCache:
+    """sha-keyed store of (module summary, per-module findings) entries,
+    duck-typed against by :func:`core.run_paths` (``get``/``put``/
+    ``save``)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.salt = package_salt()
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._files: Dict[str, dict] = {}
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if (isinstance(data, dict)
+                    and data.get("version") == _VERSION
+                    and data.get("salt") == self.salt
+                    and isinstance(data.get("files"), dict)):
+                self._files = data["files"]
+        except (OSError, ValueError):
+            pass  # cold cache
+
+    @staticmethod
+    def _key(path: str) -> str:
+        return os.path.abspath(path)
+
+    def get(self, path: str, sha: str,
+            codes: List[str]) -> Optional[dict]:
+        e = self._files.get(self._key(path))
+        if (isinstance(e, dict) and e.get("sha") == sha
+                and set(codes) <= set(e.get("rules", ()))
+                and isinstance(e.get("summary"), dict)
+                and isinstance(e.get("findings"), list)):
+            self.hits += 1
+            return e
+        self.misses += 1
+        return None
+
+    def put(self, path: str, sha: str, codes: List[str],
+            summary: dict, findings: List[dict]) -> None:
+        self._files[self._key(path)] = {
+            "sha": sha, "rules": sorted(codes),
+            "summary": summary, "findings": findings}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        payload = {"version": _VERSION, "tool": "graftlint",
+                   "salt": self.salt, "files": self._files}
+        try:
+            d = os.path.dirname(os.path.abspath(self.path)) or "."
+            fd, tmp = tempfile.mkstemp(prefix=".graftlint_cache",
+                                       dir=d, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+            self._dirty = False
+        except OSError:  # telemetry must never fail the lint run
+            try:
+                os.unlink(tmp)  # type: ignore[possibly-undefined]
+            except (OSError, UnboundLocalError):
+                pass

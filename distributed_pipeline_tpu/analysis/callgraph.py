@@ -1,0 +1,1340 @@
+"""Interprocedural graftlint: the whole-program call-graph pass.
+
+The per-module rules (GL001..GL010) see one AST at a time, which left
+three audited blind spots (ROADMAP item 6): tracedness did not propagate
+through ordinary calls, GL003 donation tracking stopped at module scope
+(the r6 orbax-restore corruption crossed exactly such a boundary), and
+GL005 could not see ``static_argnums`` declared far from the call site.
+This module turns those heuristics into proofs:
+
+* :func:`summarize_module` distills one parsed :class:`~core.Module`
+  into a **serializable** :class:`ModuleSummary` — per-function facts
+  (host-sync sites rooted at parameters, PRNG-key consumption, call
+  sites with signature-shaped argument descriptors, statement-ordered
+  read/bind events) plus the module's symbol table (functions, classes,
+  jit/partial bindings, absolutized import aliases). Serializable is
+  load-bearing: the content-hash cache (:mod:`cache`) stores summaries
+  keyed on file sha, so unchanged modules are never reparsed while the
+  cross-module pass stays exact.
+* :class:`CallGraph` links the summaries: imports resolve
+  module-to-module (through re-export chains, ``functools.partial``
+  bindings, and ``self.`` method calls), call-site arguments map to
+  callee parameters signature-aware (positional/keyword; ``*args`` and
+  ``**kwargs`` at a call site **widen honestly** — the mapping is
+  dropped rather than guessed), and monotone fixpoints flow four fact
+  families across call and module boundaries until stable (cycles and
+  recursion converge; an unknown callee contributes nothing, so a fact
+  is only ever *proven*, never assumed):
+
+  - **tracedness**: a function reachable from any jit/scan-traced
+    context is traced — its parameter-rooted host syncs are GL002
+    findings even when the helper lives two modules away;
+  - **blocking params**: a parameter a function (transitively)
+    ``float()``s / ``.item()``s — a loop passing a jitted step's output
+    into such a helper is a GL007 finding at the call site;
+  - **key consumption**: a parameter a function (transitively) feeds to
+    a ``jax.random`` sampler — the GL011 replay proves cross-module key
+    reuse instead of guessing from parameter names;
+  - **donation**: a parameter a function (transitively) passes at a
+    donated position of a jitted binding — reading a tree after the
+    donating call is GL003 even when donor and reader never share a
+    module (the r6 shape).
+
+Emission is owned here (the rules' ``check_graph`` methods delegate) so
+the propagation machinery and the messages that cite witness chains
+stay in one place.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import (
+    TRACE_WRAPPERS,
+    TRACED_ARG_POS,
+    TRACED_ARG_SUFFIXES,
+    Finding,
+    Module,
+)
+
+__all__ = ["CallGraph", "ModuleSummary", "module_name_for_path",
+           "summarize_module"]
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_MAX_CHAIN = 32  # resolution chain cap (alias/partial/re-export hops)
+
+
+# =========================================================== module naming
+
+def module_name_for_path(path: str) -> Tuple[str, bool]:
+    """(dotted module name, is_package) for a file path, by walking up
+    while ``__init__.py`` markers continue — mirrors how the interpreter
+    would import the file from the package root. A bare file in a
+    non-package dir is a top-level module named by its stem."""
+    p = os.path.abspath(path)
+    d, base = os.path.split(p)
+    stem = base[:-3] if base.endswith(".py") else base
+    is_pkg = stem == "__init__"
+    parts: List[str] = [] if is_pkg else [stem]
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        nd, name = os.path.split(d)
+        if not name or nd == d:
+            break
+        parts.append(name)
+        d = nd
+    if not parts:  # degenerate: an __init__.py outside any package
+        parts = [os.path.basename(os.path.dirname(p)) or "module"]
+    return ".".join(reversed(parts)), is_pkg
+
+
+def _absolutize(origin: str, modname: str, is_pkg: bool) -> str:
+    """Resolve a relative import origin (``.x``, ``..utils.y``) against
+    the importing module's dotted name; absolute origins pass through.
+    Unresolvable relatives (more dots than package depth) are returned
+    unchanged — they simply never match a module."""
+    if not origin.startswith("."):
+        return origin
+    level = len(origin) - len(origin.lstrip("."))
+    rest = [s for s in origin[level:].split(".") if s]
+    base = modname.split(".")
+    drop = level - 1 if is_pkg else level
+    if drop < 0 or drop >= len(base) + (1 if is_pkg else 0):
+        return origin
+    kept = base[:len(base) - drop] if drop else base
+    if not kept:
+        return origin
+    return ".".join(kept + rest)
+
+
+# ======================================================== module summaries
+
+@dataclasses.dataclass
+class ModuleSummary:
+    """Everything the cross-module pass needs from one file, as plain
+    JSON-shaped data (the cache serializes this verbatim)."""
+
+    path: str
+    modname: str
+    is_package: bool
+    aliases: Dict[str, str]
+    funcs: Dict[str, dict]
+    classes: Dict[str, List[str]]
+    jit_bindings: Dict[str, dict]
+    partials: Dict[str, dict]
+    local_donations: List[str]
+    local_jitted: List[str]
+    traced_refs: List[str]
+
+    @property
+    def relname(self) -> str:
+        return "/".join(self.path.replace(os.sep, "/").split("/")[-2:])
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleSummary":
+        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls)})
+
+
+def _site(node: ast.AST, module: Module) -> dict:
+    line = getattr(node, "lineno", 1)
+    return {"line": line, "col": getattr(node, "col_offset", 0) + 1,
+            "snippet": module.snippet(line)}
+
+
+def _root_of(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """This statement's own expression nodes: no nested statements (the
+    flatten walk visits those separately), no nested function bodies."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, ast.stmt) or isinstance(c, _FUNC_DEFS) \
+                    or isinstance(c, ast.Lambda):
+                continue
+            stack.append(c)
+
+
+def _scalar_hazard(arg: ast.AST) -> Optional[str]:
+    """The GL005 hazard shapes (one owner shared with the local rule):
+    a ``len()`` scalar, a ``.shape``-derived value, or an f-string."""
+    if isinstance(arg, ast.JoinedStr):
+        return "an f-string (fresh object per call)"
+    for n in ast.walk(arg):
+        if isinstance(n, _FUNC_DEFS) or isinstance(n, ast.Lambda):
+            return None
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return "a len() python scalar"
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return "a .shape-derived python value"
+    return None
+
+
+# ---- semantic fact tables shared with the per-module rules (rules.py
+# imports these; callgraph must not import rules — that would cycle)
+
+SYNC_NP = {"asarray", "array", "sum", "mean", "std", "var", "max", "min",
+           "argmax", "argmin", "any", "all", "allclose", "isnan",
+           "isfinite", "isinf", "where", "concatenate", "stack", "dot",
+           "matmul", "prod", "abs", "clip", "sqrt", "exp", "log",
+           "float32", "float64", "int32", "int64"}
+NP_BLOCKERS = {"numpy.asarray", "numpy.array"}
+BLOCKING_BUILTINS = {"float", "int", "bool"}
+STEP_ATTRS = {"run_step", "forward_only", "train_step", "eval_step"}
+KEY_DERIVERS = {"PRNGKey", "key", "fold_in", "key_data", "wrap_key_data",
+                "clone", "key_impl"}
+KEY_PARAM_PAT = ("rng", "key", "prng", "seed_key")
+
+
+def is_key_param(name: str) -> bool:
+    low = name.lower()
+    return any(low == p or low.endswith("_" + p) or low.startswith(p + "_")
+               or low.rstrip("0123456789") == p for p in KEY_PARAM_PAT)
+
+
+def _sync_hit(module: Module, call: ast.Call,
+              params: Set[str]) -> Optional[dict]:
+    """A host-sync operation in ``call`` whose operand roots at one of
+    ``params`` — the only syncs a *caller* can cause (traced values flow
+    in through arguments), so the transitive findings stay proofs."""
+    func = call.func
+    fn = module.resolve(func)
+    if isinstance(func, ast.Attribute) and func.attr == "item" \
+            and not call.args:
+        root = _root_of(func.value)
+        if root in params:
+            return {"param": root, "desc": ".item()", "blocking": True}
+    if isinstance(func, ast.Name) and func.id in BLOCKING_BUILTINS \
+            and len(call.args) == 1 \
+            and not isinstance(call.args[0], ast.Constant):
+        root = _root_of(call.args[0])
+        if root in params:
+            return {"param": root, "desc": f"{func.id}()",
+                    "blocking": True}
+    if fn and fn.startswith("numpy.") and fn.split(".")[-1] in SYNC_NP:
+        for a in call.args:
+            root = _root_of(a)
+            if root in params:
+                return {"param": root, "desc": fn,
+                        "blocking": fn in NP_BLOCKERS}
+    if fn == "jax.device_get" and call.args:
+        root = _root_of(call.args[0])
+        if root in params:
+            return {"param": root, "desc": "jax.device_get",
+                    "blocking": False}
+    if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+        root = _root_of(func.value)
+        if root in params:
+            return {"param": root, "desc": "block_until_ready",
+                    "blocking": False}
+    return None
+
+
+def _loop_bound_names(loop: ast.AST) -> Set[str]:
+    """Names (re)bound anywhere inside the loop body (not nested defs)."""
+    out: Set[str] = set()
+    stack: List[ast.AST] = list(ast.iter_child_nodes(loop))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNC_DEFS) or isinstance(n, ast.Lambda) \
+                or isinstance(n, ast.ClassDef):
+            continue
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+            targets = [n.target]
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            targets = [n.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            out |= {e.id for e in elts if isinstance(e, ast.Name)}
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _loop_step_names(module: Module, loop: ast.AST) -> Set[str]:
+    """Names assigned in the loop from a jitted-step-shaped call — the
+    values GL007 cares about (same heuristics as the local rule)."""
+    names: Set[str] = set()
+    for nd in ast.walk(loop):
+        if not isinstance(nd, ast.Assign) \
+                or not isinstance(nd.value, ast.Call):
+            continue
+        func = nd.value.func
+        hit = isinstance(func, ast.Attribute) and func.attr in STEP_ATTRS
+        if not hit:
+            try:
+                hit = ast.unparse(func) in module.jitted_bindings
+            except Exception:  # pragma: no cover - defensive
+                hit = False
+        if not hit:
+            continue
+        for t in nd.targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            names |= {e.id for e in elts if isinstance(e, ast.Name)}
+    return names
+
+
+def _stmt_binds(s: ast.stmt) -> List[str]:
+    targets: List[Optional[ast.AST]] = []
+    if isinstance(s, ast.Assign):
+        targets = list(s.targets)
+    elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+        targets = [s.target]
+    elif isinstance(s, (ast.For, ast.AsyncFor)):
+        targets = [s.target]
+    elif isinstance(s, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in s.items if i.optional_vars]
+    out: List[str] = []
+    for t in targets:
+        if t is None:
+            continue
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            if isinstance(e, ast.Name):
+                out.append(e.id)
+            elif isinstance(e, (ast.Attribute, ast.Subscript)):
+                try:
+                    out.append(ast.unparse(e))
+                except Exception:  # pragma: no cover - defensive
+                    pass
+    return out
+
+
+def _iter_funcs(tree: ast.AST) -> Iterator[Tuple[str, Optional[str],
+                                                 ast.AST]]:
+    """(qualname, enclosing class or None, def node) for every named
+    function, including nested defs (``outer.inner``) and methods
+    (``Class.method``)."""
+
+    def visit(node: ast.AST, prefix: str,
+              cls: Optional[str]) -> Iterator[Tuple[str, Optional[str],
+                                                    ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_DEFS):
+                q = prefix + child.name
+                yield q, cls, child
+                yield from visit(child, q + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, prefix + child.name + ".",
+                                 child.name)
+
+    yield from visit(tree, "", None)
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _summarize_function(module: Module, qual: str, cls: Optional[str],
+                        node: ast.AST) -> dict:
+    a = node.args
+    params = [p.arg for p in a.posonlyargs + a.args]
+    kwonly = [p.arg for p in a.kwonlyargs]
+    pset = set(params) | set(kwonly)
+    self_like = (bool(cls) and bool(params)
+                 and params[0] in ("self", "cls")
+                 and not any(module.resolve(d) == "staticmethod"
+                             for d in node.decorator_list))
+
+    # which loops are untraced (GL007 jurisdiction) + their step names
+    loop_cache: Dict[int, Tuple[Set[str], Set[str]]] = {}
+
+    def loop_facts(loop: ast.AST) -> Tuple[Set[str], Set[str]]:
+        key = id(loop)
+        if key not in loop_cache:
+            steps = (set() if module.in_traced(loop)
+                     else _loop_step_names(module, loop))
+            loop_cache[key] = (steps, _loop_bound_names(loop))
+        return loop_cache[key]
+
+    calls: List[dict] = []
+    syncs: List[dict] = []
+    candidates: Set[str] = set()
+    pending: List[Tuple[ast.stmt, dict]] = []
+
+    def make_ev(s: ast.stmt, loop: Optional[ast.AST]) -> dict:
+        ev: dict = {"calls": [], "binds": [], "fresh": [],
+                    "reads": [], "kuses": [], "ksplits": []}
+        step_names, loop_bound = loop_facts(loop) if loop is not None \
+            else (set(), set())
+        stmt_calls = [n for n in _shallow(s) if isinstance(n, ast.Call)]
+        stmt_calls.sort(key=lambda c: (getattr(c, "lineno", 0),
+                                       getattr(c, "col_offset", 0)))
+        for call in stmt_calls:
+            fn = module.resolve(call.func)
+            # direct PRNG use/split events (GL001 semantics, recorded so
+            # the GL011 replay can mix direct and cross-module consumers)
+            if fn and fn.startswith("jax.random."):
+                member = fn.rsplit(".", 1)[1]
+                # jax.random.* consume the KEY argument only — the
+                # first positional (or key=); counting shape/count args
+                # would poison the key-consumption fixpoint
+                key_args = [a for a in call.args[:1]
+                            if isinstance(a, ast.Name)]
+                key_args += [k.value for k in call.keywords
+                             if k.arg == "key"
+                             and isinstance(k.value, ast.Name)]
+                for arg in key_args:
+                    if member == "split":
+                        ev["ksplits"].append(
+                            {"name": arg.id, **_site(call, module)})
+                    elif member not in KEY_DERIVERS:
+                        ev["kuses"].append(
+                            {"name": arg.id, "desc": fn,
+                             **_site(call, module)})
+            hit = _sync_hit(module, call, pset)
+            if hit:
+                syncs.append({**hit, **_site(call, module)})
+            try:
+                callee = ast.unparse(call.func)
+            except Exception:  # pragma: no cover - defensive
+                continue
+            if not isinstance(call.func, (ast.Name, ast.Attribute)):
+                continue  # calls of call results etc.: unresolvable
+
+            def desc(arg: ast.AST) -> dict:
+                d: dict = {}
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    try:
+                        d["name"] = ast.unparse(arg)
+                    except Exception:  # pragma: no cover - defensive
+                        pass
+                if isinstance(arg, ast.Name):
+                    d["simple"] = True
+                if isinstance(arg, ast.Constant):
+                    d["const"] = True
+                root = _root_of(arg)
+                if root:
+                    d["root"] = root
+                    if root in step_names:
+                        d["step"] = True
+                hz = _scalar_hazard(arg)
+                if hz:
+                    d["hazard"] = hz
+                    d.update({f"h{k}": v
+                              for k, v in _site(arg, module).items()})
+                return d
+
+            site = {
+                "callee": callee,
+                **_site(call, module),
+                "pos": [desc(arg) for arg in call.args
+                        if not isinstance(arg, ast.Starred)],
+                "kw": {k.arg: desc(k.value) for k in call.keywords
+                       if k.arg},
+                "star": (any(isinstance(arg, ast.Starred)
+                             for arg in call.args)
+                         or any(k.arg is None for k in call.keywords)),
+                "in_loop": loop is not None,
+                "loop_rebound": sorted(loop_bound) if loop is not None
+                else [],
+            }
+            for d in site["pos"] + list(site["kw"].values()):
+                if d.get("root"):
+                    candidates.add(d["root"])
+            ev["calls"].append(len(calls))
+            calls.append(site)
+        binds = _stmt_binds(s)
+        ev["binds"] = binds
+        if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+            vfn = module.resolve(s.value.func)
+            if vfn and vfn.startswith("jax.random.") \
+                    and vfn.rsplit(".", 1)[1] in (KEY_DERIVERS | {"split"}):
+                ev["fresh"] = [b for b in binds if "." not in b]
+        pending.append((s, ev))
+        return ev
+
+    def build(body: List[ast.stmt], loop: Optional[ast.AST]
+              ) -> List[dict]:
+        """Statement-event tree in source order. ``if`` branches become
+        nested {"branches": [{"events", "terminates"}, ...]} entries so
+        the replays can give each arm its own state copy and drop
+        terminated arms — a consumption inside an early-``return`` body
+        must not leak into the fall-through path (the GL001 semantics,
+        kept at the summary level)."""
+        out: List[dict] = []
+        for s in body:
+            if isinstance(s, _FUNC_DEFS) or isinstance(s, ast.ClassDef):
+                continue
+            out.append(make_ev(s, loop))
+            if isinstance(s, ast.If):
+                branches = []
+                for sub in (s.body, s.orelse):
+                    if not sub:
+                        continue
+                    branches.append({"events": build(sub, loop),
+                                     "terminates": _terminates(sub)})
+                if any(br["events"] or br["terminates"]
+                       for br in branches):
+                    out.append({"branches": branches})
+            elif isinstance(s, _LOOPS):
+                out.extend(build(s.body, s))
+                out.extend(build(s.orelse, loop))
+            else:
+                for field in ("body", "orelse", "finalbody"):
+                    out.extend(build(getattr(s, field, []) or [], loop))
+                for h in getattr(s, "handlers", []) or []:
+                    out.extend(build(h.body, loop))
+        return out
+
+    events = build(node.body, None)
+
+    # second pass: reads of candidate roots (donation liveness needs the
+    # loads BETWEEN call sites, in order)
+    for s, ev in pending:
+        if not candidates:
+            break
+        for n in _shallow(s):
+            if not isinstance(n, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(n, "ctx", None), ast.Load):
+                continue
+            parent = module.parent.get(n)
+            if isinstance(parent, (ast.Attribute, ast.Subscript)) \
+                    and getattr(parent, "value", None) is n:
+                continue  # outermost chain node only
+            if isinstance(parent, ast.Call) and parent.func is n:
+                continue  # the callee position is not a data read
+            root = _root_of(n)
+            if root not in candidates:
+                continue
+            try:
+                text = ast.unparse(n)
+            except Exception:  # pragma: no cover - defensive
+                continue
+            ev["reads"].append({"text": text, **_site(n, module)})
+
+    def prune(evs: List[dict]) -> List[dict]:
+        out = []
+        for ev in evs:
+            if "branches" in ev:
+                for br in ev["branches"]:
+                    br["events"] = prune(br["events"])
+                if any(br["events"] or br["terminates"]
+                       for br in ev["branches"]):
+                    out.append(ev)
+            elif any(ev[k] for k in ("calls", "binds", "fresh", "reads",
+                                     "kuses", "ksplits")):
+                out.append(ev)
+        return out
+
+    events = prune(events)
+
+    return {
+        "qual": qual,
+        "cls": cls,
+        "line": getattr(node, "lineno", 1),
+        "params": params,
+        "kwonly": kwonly,
+        "vararg": a.vararg is not None,
+        "kwarg": a.kwarg is not None,
+        "self_like": self_like,
+        # in_traced, not bare membership: a def nested INSIDE a traced
+        # function is lexically traced too — its sync sites belong to
+        # the local GL002 rule, and the graph half must not double-
+        # report them (it still seeds the traced closure correctly)
+        "directly_traced": (node in module.traced
+                            or module.in_traced(node)),
+        "calls": calls,
+        "syncs": syncs,
+        "events": events,
+    }
+
+
+def summarize_module(module: Module) -> ModuleSummary:
+    modname, is_pkg = module_name_for_path(module.path)
+    aliases = {k: _absolutize(v, modname, is_pkg)
+               for k, v in module.imports.alias.items()}
+    funcs: Dict[str, dict] = {}
+    classes: Dict[str, List[str]] = {}
+    for qual, cls, node in _iter_funcs(module.tree):
+        funcs[qual] = _summarize_function(module, qual, cls, node)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = [b.name for b in node.body
+                                  if isinstance(b, _FUNC_DEFS)]
+    partials: Dict[str, dict] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        fn = module.resolve(node.value.func)
+        if fn not in ("functools.partial", "partial"):
+            continue
+        if not node.value.args:
+            continue
+        tgt = node.value.args[0]
+        if not isinstance(tgt, (ast.Name, ast.Attribute)):
+            continue
+        partials[node.targets[0].id] = {
+            "target": ast.unparse(tgt),
+            "n_pos": len(node.value.args) - 1,
+            "kw": [k.arg for k in node.value.keywords if k.arg],
+        }
+    traced_refs: List[str] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = module.resolve(node.func)
+        positions: Tuple[int, ...] = ()
+        if module._wrapper_name(node.func) is not None and node.args:
+            positions = (0,)
+        elif fn in TRACED_ARG_POS:
+            positions = TRACED_ARG_POS[fn]
+        elif fn is not None:
+            for suffix, pos in TRACED_ARG_SUFFIXES.items():
+                if fn.split(".")[-1] == suffix:
+                    positions = pos
+        for p in positions:
+            if p < len(node.args) and isinstance(
+                    node.args[p], (ast.Name, ast.Attribute)):
+                traced_refs.append(ast.unparse(node.args[p]))
+    return ModuleSummary(
+        path=module.path,
+        modname=modname,
+        is_package=is_pkg,
+        aliases=aliases,
+        funcs=funcs,
+        classes=classes,
+        jit_bindings={k: dict(v) for k, v in module.jit_info.items()
+                      if "." not in k},  # only plain names are importable
+        partials=partials,
+        local_donations=sorted(module.donations),
+        local_jitted=sorted(module.jitted_bindings),
+        traced_refs=traced_refs,
+    )
+
+
+# ============================================================== call graph
+
+@dataclasses.dataclass
+class Target:
+    """Resolution of a call-site callee: a function summary, a jitted
+    binding (with its donate/static facts and, when resolvable, the
+    wrapped function), or unknown (honest widening: contributes no
+    facts). ``offset`` is the positional shift accumulated through
+    ``functools.partial`` chains."""
+
+    kind: str                         # "func" | "jit" | "unknown"
+    module: Optional[ModuleSummary] = None
+    qual: Optional[str] = None
+    offset: int = 0
+    self_call: bool = False
+    jit: Optional[dict] = None
+
+    @property
+    def fid(self) -> Optional[Tuple[str, str]]:
+        if self.module is not None and self.qual is not None:
+            return (self.module.path, self.qual)
+        return None
+
+    def label(self) -> str:
+        if self.module is not None and self.qual is not None:
+            return f"{self.module.relname}:{self.qual}"
+        return "<unknown>"
+
+
+_UNKNOWN = Target("unknown")
+
+
+class CallGraph:
+    """Whole-program view over every module summary: symbol resolution,
+    the call-edge table, and the four fixpoint fact families. All
+    construction is lazy (``_build``) and pure over summaries, so a
+    cache-served run never needs an AST."""
+
+    def __init__(self, summaries: Dict[str, ModuleSummary]) -> None:
+        self.by_path: Dict[str, ModuleSummary] = dict(summaries)
+        self.by_name: Dict[str, ModuleSummary] = {}
+        for s in self.by_path.values():
+            self.by_name.setdefault(s.modname, s)
+        self._built = False
+
+    # ---------------------------------------------------------- resolution
+
+    def _find_module(self, dotted: str
+                     ) -> Optional[Tuple[ModuleSummary, str]]:
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            m = self.by_name.get(".".join(parts[:i]))
+            if m is not None:
+                return m, ".".join(parts[i:])
+        return None
+
+    def resolve(self, mod: ModuleSummary, text: str,
+                scope_qual: Optional[str] = None,
+                cls: Optional[str] = None) -> Target:
+        return self._resolve(mod, text, scope_qual, cls, set())
+
+    def _resolve(self, mod: ModuleSummary, text: str,
+                 scope_qual: Optional[str],
+                 cls: Optional[str],
+                 seen: Set[Tuple[str, str]]) -> Target:
+        offset = 0
+        for _ in range(_MAX_CHAIN):
+            key = (mod.path, text)
+            if key in seen:
+                return _UNKNOWN  # import cycle in the alias chain
+            seen.add(key)
+            if cls and text.startswith(("self.", "cls.")):
+                m = text.split(".", 1)[1]
+                if "." not in m and m in mod.classes.get(cls, ()):
+                    return Target("func", mod, f"{cls}.{m}", offset,
+                                  self_call=True)
+                return _UNKNOWN
+            if "." not in text:
+                if scope_qual:  # nested def visible from the scope chain
+                    parts = scope_qual.split(".")
+                    for i in range(len(parts), 0, -1):
+                        cand = ".".join(parts[:i]) + "." + text
+                        if cand in mod.funcs:
+                            return Target("func", mod, cand, offset)
+                if text in mod.funcs:
+                    return Target("func", mod, text, offset)
+                if text in mod.jit_bindings:
+                    info = mod.jit_bindings[text]
+                    inner = _UNKNOWN
+                    if info.get("target"):
+                        # same `seen` guard: `f = jax.jit(f)` rebinding
+                        # chains must terminate, not recurse
+                        inner = self._resolve(mod, info["target"],
+                                              None, None, seen)
+                    return Target("jit", inner.module, inner.qual,
+                                  offset, jit=info)
+                if text in mod.partials:
+                    p = mod.partials[text]
+                    offset += int(p["n_pos"])
+                    text = p["target"]
+                    scope_qual = cls = None
+                    continue
+                if text in mod.aliases:
+                    found = self._find_module(mod.aliases[text])
+                    if found is None:
+                        return _UNKNOWN
+                    mod, rest = found
+                    if not rest:
+                        return _UNKNOWN  # a module object, not a callable
+                    text = rest
+                    scope_qual = cls = None
+                    continue
+                return _UNKNOWN
+            root, rest = text.split(".", 1)
+            if root in mod.aliases:
+                found = self._find_module(mod.aliases[root] + "." + rest)
+                if found is None:
+                    return _UNKNOWN
+                mod, text = found
+                if not text:
+                    return _UNKNOWN
+                scope_qual = cls = None
+                continue
+            if root in mod.classes and "." not in rest \
+                    and rest in mod.classes[root]:
+                # Class.method(obj, ...): arg 0 binds self, no shift
+                return Target("func", mod, f"{root}.{rest}", offset)
+            return _UNKNOWN
+        return _UNKNOWN
+
+    # -------------------------------------------------------- construction
+
+    def _build(self) -> None:
+        if self._built:
+            return
+        # resolved call targets, aligned with each function's calls list
+        self.targets: Dict[Tuple[str, str], List[Target]] = {}
+        # call edges into each function: fid -> [(caller fid, site, target)]
+        self.edges_in: Dict[Tuple[str, str],
+                            List[Tuple[Tuple[str, str], dict,
+                                       Target]]] = {}
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                fid = (path, qual)
+                resolved: List[Target] = []
+                for site in fs["calls"]:
+                    t = self.resolve(mod, site["callee"],
+                                     scope_qual=qual, cls=fs.get("cls"))
+                    resolved.append(t)
+                    tfid = t.fid
+                    if tfid is not None:
+                        self.edges_in.setdefault(tfid, []).append(
+                            (fid, site, t))
+                self.targets[fid] = resolved
+        self._traced = self._traced_closure()
+        self._blocking = self._param_fixpoint(self._blocking_seeds())
+        self._keys = self._param_fixpoint(self._key_seeds())
+        self._donating = self._param_fixpoint(self._donation_seeds())
+        self._built = True  # only a COMPLETE build counts (an exception
+        # mid-build must rebuild, not serve half-initialized fact maps)
+
+    def _func(self, fid: Tuple[str, str]) -> dict:
+        return self.by_path[fid[0]].funcs[fid[1]]
+
+    # tracedness: function-level reachability from traced contexts
+    def _traced_closure(self) -> Dict[Tuple[str, str],
+                                      Optional[Tuple[Tuple[str, str],
+                                                     dict]]]:
+        """fid -> witness (caller fid, call site) or None for seeds."""
+        closure: Dict[Tuple[str, str], Optional[Tuple[Tuple[str, str],
+                                                      dict]]] = {}
+        queue: List[Tuple[str, str]] = []
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                if fs["directly_traced"]:
+                    closure[(path, qual)] = None
+                    queue.append((path, qual))
+            seeds: List[Target] = []
+            for info in mod.jit_bindings.values():
+                if info.get("target"):
+                    seeds.append(self.resolve(mod, info["target"]))
+            for ref in mod.traced_refs:
+                seeds.append(self.resolve(mod, ref))
+            for t in seeds:
+                fid = t.fid
+                if t.kind == "func" and fid is not None \
+                        and fid not in closure:
+                    closure[fid] = None
+                    queue.append(fid)
+        while queue:
+            fid = queue.pop()
+            for site, target in zip(self._func(fid)["calls"],
+                                    self.targets[fid]):
+                nxt = target.fid
+                if nxt is None or nxt in closure:
+                    continue
+                closure[nxt] = (fid, site)
+                queue.append(nxt)
+        return closure
+
+    # generic backward (callee -> caller) parameter-taint fixpoint
+    def _param_fixpoint(self, seeds: Dict[Tuple[str, str, str], dict]
+                        ) -> Dict[Tuple[str, str, str], dict]:
+        """seeds: (path, qual, param) -> {"desc", "line", "snippet"}
+        (terminal facts). Propagates through call sites whose argument
+        roots at a caller parameter; each propagated entry records its
+        next hop so messages can cite the chain. Monotone set growth +
+        finite universe => cycles/recursion converge."""
+        facts = dict(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for fid, resolved in self.targets.items():
+                fs = self._func(fid)
+                pset = set(fs["params"]) | set(fs["kwonly"])
+                for site, target in zip(fs["calls"], resolved):
+                    mapping = self.map_args(site, target)
+                    if not mapping:
+                        continue
+                    tfid = target.fid
+                    if tfid is None:
+                        continue
+                    for arg, pname in mapping:
+                        root = arg.get("root")
+                        if root not in pset:
+                            continue
+                        down = facts.get((tfid[0], tfid[1], pname))
+                        if down is None:
+                            continue
+                        key = (fid[0], fid[1], root)
+                        if key in facts:
+                            continue
+                        facts[key] = {"via": site, "via_label":
+                                      target.label(), "next": down}
+                        changed = True
+        return facts
+
+    def _blocking_seeds(self) -> Dict[Tuple[str, str, str], dict]:
+        seeds: Dict[Tuple[str, str, str], dict] = {}
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                for s in fs["syncs"]:
+                    if s.get("blocking"):
+                        seeds.setdefault((path, qual, s["param"]), s)
+        return seeds
+
+    @staticmethod
+    def _iter_stmt_events(events: List[dict]) -> Iterator[dict]:
+        """Flat view over an event tree (branch structure is only
+        meaningful to the ordered replays; seeding is order-free)."""
+        stack = list(reversed(events))
+        while stack:
+            ev = stack.pop()
+            if "branches" in ev:
+                for br in ev["branches"]:
+                    stack.extend(reversed(br["events"]))
+                continue
+            yield ev
+
+    def _key_seeds(self) -> Dict[Tuple[str, str, str], dict]:
+        seeds: Dict[Tuple[str, str, str], dict] = {}
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                pset = set(fs["params"]) | set(fs["kwonly"])
+                for ev in self._iter_stmt_events(fs["events"]):
+                    for u in ev["kuses"] + ev["ksplits"]:
+                        if u["name"] in pset:
+                            seeds.setdefault(
+                                (path, qual, u["name"]),
+                                {"desc": u.get("desc", "jax.random.split"),
+                                 "line": u["line"],
+                                 "snippet": u["snippet"]})
+        return seeds
+
+    def _donation_seeds(self) -> Dict[Tuple[str, str, str], dict]:
+        """Parameters passed directly at a donated position of a jit
+        binding; the generic fixpoint then carries donation up through
+        forwarding callers."""
+        seeds: Dict[Tuple[str, str, str], dict] = {}
+        for fid, resolved in self.targets.items():
+            fs = self._func(fid)
+            pset = set(fs["params"]) | set(fs["kwonly"])
+            for site, target in zip(fs["calls"], resolved):
+                if target.kind != "jit" or not target.jit \
+                        or not target.jit.get("donate"):
+                    continue
+                for d in target.jit["donate"]:
+                    cp = int(d) - target.offset
+                    if not 0 <= cp < len(site["pos"]):
+                        continue
+                    arg = site["pos"][cp]
+                    root = arg.get("root")
+                    if arg.get("simple") and root in pset:
+                        seeds.setdefault(
+                            (fid[0], fid[1], root),
+                            {"desc": f"donated to {target.label()}",
+                             "line": site["line"],
+                             "snippet": site["snippet"]})
+        return seeds
+
+    # ------------------------------------------------------------- mapping
+
+    def map_args(self, site: dict, target: Target
+                 ) -> Optional[List[Tuple[dict, str]]]:
+        """(arg descriptor, callee parameter name) pairs, or None when
+        the mapping cannot be trusted (* / ** at the call site, unknown
+        callee) — honest widening, not a guess."""
+        fid = target.fid
+        if fid is None or site.get("star"):
+            return None
+        fs = self._func(fid)
+        params = fs["params"]
+        shift = target.offset + (1 if target.self_call and fs["self_like"]
+                                 else 0)
+        out: List[Tuple[dict, str]] = []
+        for i, arg in enumerate(site["pos"]):
+            j = i + shift
+            if j < len(params):
+                out.append((arg, params[j]))
+        for k, arg in site["kw"].items():
+            if k in params or k in fs["kwonly"]:
+                out.append((arg, k))
+        return out
+
+    def _donated_args(self, site: dict, target: Target
+                      ) -> List[Tuple[dict, int]]:
+        """(arg descriptor, underlying position) pairs this call site
+        donates — directly via a jit binding's donate_argnums, or through
+        a callee that (transitively) donates the mapped parameter."""
+        out: List[Tuple[dict, int]] = []
+        if target.kind == "jit" and target.jit \
+                and target.jit.get("donate"):
+            for d in target.jit["donate"]:
+                cp = int(d) - target.offset
+                if 0 <= cp < len(site["pos"]):
+                    out.append((site["pos"][cp], int(d)))
+        elif target.kind == "func":
+            mapping = self.map_args(site, target)
+            if mapping:
+                tfid = target.fid
+                for arg, pname in mapping:
+                    if (tfid[0], tfid[1], pname) in self._donating:
+                        out.append((arg, -1))
+        return out
+
+    # ------------------------------------------------------------ messages
+
+    @staticmethod
+    def _chain(fact: dict, limit: int = 4) -> str:
+        hops: List[str] = []
+        cur: Optional[dict] = fact
+        while cur is not None and len(hops) < limit:
+            if "via_label" in cur:
+                hops.append(f"via {cur['via_label']}")
+                cur = cur.get("next")
+            else:
+                hops.append(f"{cur.get('desc', '?')} at line "
+                            f"{cur.get('line', '?')}")
+                cur = None
+        return " ".join(hops)
+
+    @staticmethod
+    def _terminal(fact: dict) -> dict:
+        cur = fact
+        while "next" in cur:
+            cur = cur["next"]
+        return cur
+
+    def _finding(self, rule: Any, path: str, site: dict,
+                 message: str) -> Finding:
+        return Finding(rule=rule.code, path=path, line=site["line"],
+                       col=site.get("col", 1), message=message,
+                       snippet=site.get("snippet", ""))
+
+    # ------------------------------------------------------------ emitters
+
+    def iter_transitive_host_syncs(self, rule: Any) -> Iterator[Finding]:
+        """GL002 upgrade: parameter-rooted host syncs in functions that
+        any traced context reaches transitively (the function itself is
+        not lexically traced — those sites are the local rule's)."""
+        self._build()
+        emitted: Set[Tuple[str, str, int, int]] = set()
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                fid = (path, qual)
+                if fs["directly_traced"] or not fs["syncs"]:
+                    continue
+                if fid not in self._traced:
+                    continue
+                # which params provably receive a traced (non-constant)
+                # value from a traced caller
+                hot: Dict[str, str] = {}
+                for caller, site, target in self.edges_in.get(fid, ()):
+                    if caller not in self._traced:
+                        continue
+                    mapping = self.map_args(site, target)
+                    if not mapping:
+                        continue
+                    cmod = self.by_path[caller[0]]
+                    for arg, pname in mapping:
+                        if arg.get("const"):
+                            continue
+                        hot.setdefault(
+                            pname,
+                            f"{cmod.relname}:{caller[1]} "
+                            f"(line {site['line']})")
+                for s in fs["syncs"]:
+                    who = hot.get(s["param"])
+                    if who is None:
+                        continue
+                    key = (path, qual, s["line"], s["col"])
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    yield self._finding(
+                        rule, path, s,
+                        f"{s['desc']} on parameter '{s['param']}' of "
+                        f"'{qual}' — this helper is reached from traced "
+                        f"code (called by {who}), so the sync happens "
+                        "inside jit tracing; hoist the conversion out "
+                        "or keep it a device value")
+
+    def iter_loop_blocking_calls(self, rule: Any) -> Iterator[Finding]:
+        """GL007 upgrade: a call inside an untraced loop hands a jitted
+        step's output to a helper that (transitively) blocks on it."""
+        self._build()
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                fid = (path, qual)
+                for site, target in zip(fs["calls"], self.targets[fid]):
+                    if not site["in_loop"] or target.kind != "func":
+                        continue
+                    mapping = self.map_args(site, target)
+                    if not mapping:
+                        continue
+                    tfid = target.fid
+                    for arg, pname in mapping:
+                        if not arg.get("step"):
+                            continue
+                        fact = self._blocking.get(
+                            (tfid[0], tfid[1], pname))
+                        if fact is None:
+                            continue
+                        term = self._terminal(fact)
+                        yield self._finding(
+                            rule, path, site,
+                            f"'{target.label()}' blocks on its "
+                            f"'{pname}' argument "
+                            f"({term.get('desc', '?')} at line "
+                            f"{term.get('line', '?')}) — calling it on "
+                            "a step output inside the loop is a per-"
+                            "step host sync that defeats async "
+                            "dispatch; pass a device value through or "
+                            "fetch once outside the loop")
+                        break  # one finding per call site
+
+    def iter_cross_module_donations(self, rule: Any) -> Iterator[Finding]:
+        """GL003 upgrade: replay each function's statement events; a
+        read after a call that donated the value — through an imported
+        jitted binding or a helper that transitively donates — is
+        use-after-free even when donor and reader share no module.
+        ``if`` arms replay on their own state copy; terminated arms are
+        dropped, surviving arms merge by union (a buffer dead on ANY
+        surviving path is a hazard on that path)."""
+        self._build()
+        for path, mod in self.by_path.items():
+            local = set(mod.local_donations)
+            for qual, fs in mod.funcs.items():
+                fid = (path, qual)
+                yield from self._replay_donations(
+                    rule, path, fs, self.targets[fid], local,
+                    fs["events"], {})
+
+    def _replay_donations(self, rule: Any, path: str, fs: dict,
+                          resolved: List[Target], local: Set[str],
+                          events: List[dict],
+                          armed: Dict[str, str]) -> Iterator[Finding]:
+        for ev in events:
+            if "branches" in ev:
+                survivors: List[Dict[str, str]] = []
+                for br in ev["branches"]:
+                    st = dict(armed)
+                    yield from self._replay_donations(
+                        rule, path, fs, resolved, local,
+                        br["events"], st)
+                    if not br["terminates"]:
+                        survivors.append(st)
+                if survivors:
+                    armed.clear()
+                    for st in survivors:
+                        armed.update(st)
+                continue
+            for r in ev["reads"]:
+                for d in sorted(armed):
+                    if r["text"] == d or r["text"].startswith(d + "."):
+                        yield self._finding(
+                            rule, path, r,
+                            f"'{d}' was {armed[d]} — its buffer "
+                            "is dead after the donating call; "
+                            "reading it is use-after-free (copy "
+                            "first or use the call's result)")
+                        armed.pop(d)
+                        break
+            for idx in ev["calls"]:
+                site = fs["calls"][idx]
+                target = resolved[idx]
+                if site["callee"] in local:
+                    continue  # the local rule owns this donor
+                for arg, _pos in self._donated_args(site, target):
+                    name = arg.get("name")
+                    if name and name not in ev["binds"]:
+                        armed[name] = (f"donated to "
+                                       f"'{target.label()}' at "
+                                       f"line {site['line']}")
+            for b in ev["binds"]:
+                for d in list(armed):
+                    if d == b or d.startswith(b + "."):
+                        armed.pop(d)
+
+    def iter_distant_static_hazards(self, rule: Any) -> Iterator[Finding]:
+        """GL005 upgrade: shape-derived scalars / f-strings flowing into
+        a jitted binding that lives in ANOTHER module (or behind a
+        partial chain), unless the argument position/name is declared
+        static at the distant jax.jit site."""
+        self._build()
+        for path, mod in self.by_path.items():
+            local = set(mod.local_jitted)
+            for qual, fs in mod.funcs.items():
+                fid = (path, qual)
+                for site, target in zip(fs["calls"], self.targets[fid]):
+                    if target.kind != "jit" or site["callee"] in local:
+                        continue
+                    info = target.jit or {}
+                    argnums = {int(x) for x in
+                               info.get("static_argnums", ())}
+                    argnames = set(info.get("static_argnames", ()))
+                    inner_params: List[str] = []
+                    if target.fid is not None:
+                        inner_params = self._func(target.fid)["params"]
+                    for i, arg in enumerate(site["pos"]):
+                        if not arg.get("hazard"):
+                            continue
+                        up = i + target.offset
+                        pname = (inner_params[up]
+                                 if up < len(inner_params) else None)
+                        if up in argnums or (pname and pname in argnames):
+                            continue
+                        yield self._hazard_finding(rule, path, site, arg,
+                                                   target)
+                    for k, arg in site["kw"].items():
+                        if not arg.get("hazard"):
+                            continue
+                        static = k in argnames or (
+                            k in inner_params
+                            and inner_params.index(k) in argnums)
+                        if static:
+                            continue
+                        yield self._hazard_finding(rule, path, site, arg,
+                                                   target)
+
+    def _hazard_finding(self, rule: Any, path: str, site: dict,
+                        arg: dict, target: Target) -> Finding:
+        where = {"line": arg.get("hline", site["line"]),
+                 "col": arg.get("hcol", site.get("col", 1)),
+                 "snippet": arg.get("hsnippet", site.get("snippet", ""))}
+        return self._finding(
+            rule, path, where,
+            f"{arg['hazard']} flows into '{site['callee']}' — a jitted "
+            f"binding declared at {target.label() if target.fid else 'a distant site'} "
+            "whose static_argnums/static_argnames do not cover this "
+            "argument; every new value retraces and recompiles (mark it "
+            "static at the jax.jit site or derive it inside the jit)")
+
+    def iter_cross_module_key_reuse(self, rule: Any) -> Iterator[Finding]:
+        """GL011: replay each function's events tracking its key-named
+        parameters; a key consumed twice — where at least one consumer
+        is a (transitively proven) key-consuming callee — or consumed
+        after a split, or consumed every loop iteration by a proven
+        consumer without rebinding, is correlated randomness the local
+        GL001 could not see."""
+        self._build()
+        for path, mod in self.by_path.items():
+            for qual, fs in mod.funcs.items():
+                fid = (path, qual)
+                keys = [p for p in fs["params"] + fs["kwonly"]
+                        if is_key_param(p)]
+                if not keys:
+                    continue
+                state: Dict[str, dict] = {
+                    k: {"uses": [], "split": False} for k in keys}
+                yield from self._replay_keys(
+                    rule, path, fs, self.targets[fid], fs["events"],
+                    state)
+
+    def _replay_keys(self, rule: Any, path: str, fs: dict,
+                     resolved: List[Target], events: List[dict],
+                     state: Dict[str, dict]) -> Iterator[Finding]:
+
+        def consume(name: str, kind: str, label: str,
+                    site: dict) -> Optional[Finding]:
+            st = state.get(name)
+            if st is None:
+                return None
+            finding = None
+            if st["split"] and kind == "callee":
+                # a DIRECT use-after-split is GL001's finding already;
+                # this rule only owns the half that crosses a call
+                finding = self._finding(
+                    rule, path, site,
+                    f"key '{name}' consumed by {label} after "
+                    "jax.random.split — use one of the split "
+                    "results instead")
+            elif st["uses"] and (kind == "callee" or any(
+                    k2 == "callee" for k2, _l in st["uses"])):
+                first = st["uses"][0][1]
+                finding = self._finding(
+                    rule, path, site,
+                    f"key '{name}' consumed more than once: "
+                    f"first by {first}, again by {label} — the "
+                    "two consumers draw correlated randomness; "
+                    "derive per-consumer keys with "
+                    "jax.random.split/fold_in")
+            st["uses"].append((kind, label))
+            if finding is not None:
+                state[name] = {"uses": [], "split": False}
+            return finding
+
+        for ev in events:
+            if "branches" in ev:
+                survivors: List[Dict[str, dict]] = []
+                for br in ev["branches"]:
+                    st2 = {k: {"uses": list(v["uses"]),
+                               "split": v["split"]}
+                           for k, v in state.items()}
+                    yield from self._replay_keys(
+                        rule, path, fs, resolved, br["events"], st2)
+                    if not br["terminates"]:
+                        survivors.append(st2)
+                if survivors:
+                    # GL001 merge semantics: a key survives only if every
+                    # surviving arm still tracks it; uses = the heaviest
+                    # arm's, split = any arm's
+                    for name in list(state):
+                        alive = [s[name] for s in survivors
+                                 if name in s]
+                        if len(alive) < len(survivors):
+                            state.pop(name)
+                            continue
+                        best = max(alive, key=lambda s: len(s["uses"]))
+                        state[name] = {
+                            "uses": list(best["uses"]),
+                            "split": any(s["split"] for s in alive)}
+                continue
+            for n in ev["fresh"]:
+                if n in state:
+                    state[n] = {"uses": [], "split": False}
+            for u in ev["kuses"]:
+                f = consume(u["name"], "direct",
+                            f"{u.get('desc', 'jax.random')} "
+                            f"(line {u['line']})", u)
+                if f is not None:
+                    yield f
+            for u in ev["ksplits"]:
+                st = state.get(u["name"])
+                if st is None:
+                    continue
+                if any(k2 == "callee" for k2, _l in st["uses"]):
+                    yield self._finding(
+                        rule, path, u,
+                        f"key '{u['name']}' split after already "
+                        f"being consumed by "
+                        f"{st['uses'][0][1]} — the split "
+                        "results correlate with the earlier "
+                        "draw")
+                    state[u["name"]] = {"uses": [], "split": False}
+                    continue
+                st["split"] = True
+            for idx in ev["calls"]:
+                site = fs["calls"][idx]
+                target = resolved[idx]
+                if target.kind not in ("func", "jit"):
+                    continue
+                mapping = self.map_args(site, target)
+                if not mapping:
+                    continue
+                tfid = target.fid
+                for arg, pname in mapping:
+                    name = arg.get("root")
+                    if not arg.get("simple") or name not in state:
+                        continue
+                    fact = self._keys.get((tfid[0], tfid[1], pname))
+                    if fact is None:
+                        continue
+                    term = self._terminal(fact)
+                    label = (f"'{target.label()}' "
+                             f"({term.get('desc', 'jax.random')}"
+                             f" at line {term.get('line', '?')})")
+                    if site["in_loop"] \
+                            and name not in site["loop_rebound"]:
+                        yield self._finding(
+                            rule, path, site,
+                            f"key '{name}' from outside the "
+                            f"loop is consumed by {label} every "
+                            "iteration without rebinding — same "
+                            "randomness each pass; fold_in the "
+                            "loop index")
+                        state[name] = {"uses": [], "split": False}
+                        continue
+                    f = consume(name, "callee", label, site)
+                    if f is not None:
+                        yield f
+            for b in ev["binds"]:
+                # rebound to a non-key: stop tracking (fresh-key
+                # rebinds were reset above instead)
+                if b in state and b not in ev["fresh"]:
+                    state.pop(b)

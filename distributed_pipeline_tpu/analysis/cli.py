@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
 from .baseline import BASELINE_NAME, Baseline, discover_baseline, path_tail
+from .cache import CACHE_NAME, AnalysisCache
 from .core import all_rules, iter_py_files, run_paths
 from . import rules as _rules  # noqa: F401  (register the catalog)
 
@@ -25,11 +27,17 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m distributed_pipeline_tpu.analysis",
         description="graftlint: JAX-aware static analysis "
                     "(PRNG reuse, host syncs, donation, purity, "
-                    "recompiles, compat bypasses)")
+                    "recompiles, compat bypasses), interprocedural: a "
+                    "whole-program call-graph pass flows tracedness/"
+                    "donation/static-argnum/key facts across module "
+                    "boundaries")
     p.add_argument("paths", nargs="*", metavar="PATH",
                    help="files or directories to lint")
-    p.add_argument("--format", choices=("human", "json"), default="human",
-                   help="report format (default: human)")
+    p.add_argument("--format", choices=("human", "json", "github"),
+                   default="human",
+                   help="report format (default: human); 'github' emits "
+                        "::error file=...,line=...:: workflow annotations "
+                        "so CI surfaces findings inline")
     p.add_argument("--baseline", default="auto", metavar="FILE",
                    help=f"baseline file; 'auto' (default) discovers "
                         f"{BASELINE_NAME} in cwd or above the first PATH; "
@@ -42,7 +50,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: all), e.g. GL001,GL004")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--changed", nargs="*", default=None, metavar="FILE",
+                   help="report only findings in these files (the whole "
+                        "program is still analyzed — cross-module facts "
+                        "need every summary; this scopes the REPORT, for "
+                        "per-PR CI annotation)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the content-hash parse/summary cache "
+                        f"({CACHE_NAME} beside the baseline)")
     return p
+
+
+def _github_lines(findings) -> List[str]:
+    """GitHub Actions workflow-command annotations. Newlines/percent in
+    messages are URL-style escaped per the workflow-command spec."""
+    out = []
+    for f in findings:
+        msg = f"{f.rule} {f.message}"
+        msg = (msg.replace("%", "%25").replace("\r", "%0D")
+               .replace("\n", "%0A"))
+        out.append(f"::error file={f.path},line={f.line},"
+                   f"col={f.col},title=graftlint {f.rule}::{msg}")
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -64,7 +93,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"error: no rules match {args.rules!r}", file=sys.stderr)
             return 2
 
-    findings, n_files = run_paths(args.paths, rules)
+    cache = None
+    if not args.no_cache:
+        # the cache lives beside the baseline (one discovery rule for
+        # both committed-state files); no baseline home -> no cache,
+        # rather than scattering cache files into arbitrary cwds
+        home = discover_baseline(args.paths[0] if args.paths else None)
+        if home:
+            cache = AnalysisCache(
+                os.path.join(os.path.dirname(home), CACHE_NAME))
+
+    findings, n_files = run_paths(args.paths, rules, cache=cache)
+    if cache is not None:
+        print(f"# cache: {cache.hits} hit(s), {cache.misses} miss(es)",
+              file=sys.stderr)
     if n_files == 0:
         # a gate that lints zero files vouches for nothing — a typo'd CI
         # path must fail loudly, not report OK
@@ -122,8 +164,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
     new, baselined = (findings, []) if baseline is None \
         else baseline.split(findings)
+    if args.changed is not None:
+        # scope the REPORT (and the exit code) to the changed files;
+        # the analysis itself stayed whole-program
+        changed = {os.path.abspath(c) for c in args.changed}
+        new = [f for f in new if os.path.abspath(f.path) in changed]
 
-    if args.format == "json":
+    if args.format == "github":
+        for line in _github_lines(new):
+            print(line)
+        print(f"{'FAIL' if new else 'OK'} {n_files} file(s), "
+              f"{len(new)} finding(s)"
+              + (f", {len(baselined)} baselined" if baselined else ""),
+              file=sys.stderr)
+    elif args.format == "json":
         print(json.dumps({
             "version": 1,
             "tool": "graftlint",

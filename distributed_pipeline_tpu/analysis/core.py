@@ -104,11 +104,19 @@ class Imports:
                         root = a.name.split(".")[0]
                         self.alias[root] = root
             elif isinstance(node, ast.ImportFrom):
-                mod = "." * node.level + (node.module or "")
                 for a in node.names:
                     if a.name == "*":
                         continue
-                    self.alias[a.asname or a.name] = f"{mod}.{a.name}"
+                    # normalize so the dot count always equals the
+                    # relative level: ``from . import x`` -> ``.x`` (the
+                    # old spelling "..x" was indistinguishable from a
+                    # level-2 import, which matters to the call-graph
+                    # pass's module-to-module resolution)
+                    if node.module:
+                        origin = "." * node.level + f"{node.module}.{a.name}"
+                    else:
+                        origin = "." * node.level + a.name
+                    self.alias[a.asname or a.name] = origin
 
 
 class Module:
@@ -129,8 +137,10 @@ class Module:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.defs_by_name.setdefault(node.name, []).append(node)
         self.traced: set = self._find_traced()
-        # binding text -> donated positional indices; jitted_bindings is the
-        # superset (any binding known to hold a jitted callable)
+        # binding text -> {"donate", "static_argnums", "static_argnames",
+        # "target"}; donations/jitted_bindings are the derived views the
+        # per-module rules consume
+        self.jit_info: Dict[str, Dict[str, Any]] = {}
         self.donations: Dict[str, Tuple[int, ...]] = {}
         self.jitted_bindings: set = set()
         self._find_jit_bindings()
@@ -204,20 +214,45 @@ class Module:
     # ------------------------------------------------------- donation map
 
     @staticmethod
-    def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
-        for kw in call.keywords:
-            if kw.arg == "donate_argnums":
-                try:
-                    val = ast.literal_eval(kw.value)
-                except (ValueError, SyntaxError):
-                    return ()
-                if isinstance(val, int):
-                    return (val,)
-                try:
-                    return tuple(int(v) for v in val)
-                except (TypeError, ValueError):
-                    return ()
+    def _literal_tuple(kws: List[ast.keyword], name: str,
+                       want: type) -> Tuple[Any, ...]:
+        """Literal value of keyword ``name`` coerced to a tuple of
+        ``want``; () when absent or not statically evaluable."""
+        for kw in kws:
+            if kw.arg != name:
+                continue
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return ()
+            if isinstance(val, want):
+                return (val,)
+            try:
+                return tuple(want(v) for v in val)
+            except (TypeError, ValueError):
+                return ()
         return ()
+
+    @classmethod
+    def _jit_kw_info(cls, kws: List[ast.keyword],
+                     target: Optional[str]) -> Dict[str, Any]:
+        return {
+            "donate": cls._literal_tuple(kws, "donate_argnums", int),
+            "static_argnums": cls._literal_tuple(kws, "static_argnums",
+                                                 int),
+            "static_argnames": cls._literal_tuple(kws, "static_argnames",
+                                                  str),
+            "target": target,
+        }
+
+    def _jit_call_keywords(self, call: ast.Call) -> List[ast.keyword]:
+        """Keywords carrying jit options: the call's own, plus — for the
+        ``partial(jax.jit, static_argnums=...)(f)`` spelling — the inner
+        partial call's."""
+        kws = list(call.keywords)
+        if isinstance(call.func, ast.Call):
+            kws += list(call.func.keywords)
+        return kws
 
     def _binding_target(self, call: ast.Call) -> Optional[str]:
         """Source text of the Name/Attribute this call's result is bound
@@ -240,20 +275,28 @@ class Module:
                 target = self._binding_target(node)
                 if target is None:
                     continue
-                self.jitted_bindings.add(target)
-                pos = self._donated_positions(node)
-                if pos:
-                    self.donations[target] = pos
+                wrapped = (ast.unparse(node.args[0]) if node.args
+                           and isinstance(node.args[0],
+                                          (ast.Name, ast.Attribute))
+                           else None)
+                info = self._jit_kw_info(self._jit_call_keywords(node),
+                                         wrapped)
+                self._record_binding(target, info)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
                     if (self._wrapper_name(dec) == "jax.jit"
                             or (isinstance(dec, ast.Call) and
                                 self._wrapper_name(dec.func) == "jax.jit")):
-                        self.jitted_bindings.add(node.name)
-                        if isinstance(dec, ast.Call):
-                            pos = self._donated_positions(dec)
-                            if pos:
-                                self.donations[node.name] = pos
+                        kws = (list(dec.keywords)
+                               if isinstance(dec, ast.Call) else [])
+                        self._record_binding(
+                            node.name, self._jit_kw_info(kws, node.name))
+
+    def _record_binding(self, name: str, info: Dict[str, Any]) -> None:
+        self.jit_info[name] = info
+        self.jitted_bindings.add(name)
+        if info["donate"]:
+            self.donations[name] = tuple(info["donate"])
 
     # ------------------------------------------------------------ helpers
 
@@ -271,18 +314,34 @@ class Module:
 
 class Rule:
     """One hazard class. Subclasses set ``code``/``description`` and
-    implement ``check`` yielding findings for a module."""
+    implement ``check`` (per-module findings) and/or ``check_graph``
+    (whole-program findings off the interprocedural call graph —
+    :mod:`callgraph`). A rule may have either half or both: the local
+    half sees one AST, the graph half sees every module's summary plus
+    the fixpoint facts (tracedness, key consumption, donation, statics)
+    that flow across call and module boundaries."""
 
     code: str = ""
     description: str = ""
 
     def check(self, module: Module) -> Iterator[Finding]:
-        raise NotImplementedError
+        return iter(())
+
+    def check_graph(self, graph: Any) -> Iterator[Finding]:
+        """Findings provable only with the whole-program call graph
+        (a :class:`callgraph.CallGraph`). Default: none."""
+        return iter(())
 
     def run(self, module: Module) -> List[Finding]:
         try:
             return list(self.check(module))
         except RecursionError:  # pathological nesting: skip, don't crash
+            return []
+
+    def run_graph(self, graph: Any) -> List[Finding]:
+        try:
+            return list(self.check_graph(graph))
+        except RecursionError:  # pragma: no cover - defensive
             return []
 
 
@@ -330,26 +389,73 @@ def _assign_indices(findings: List[Finding]) -> List[Finding]:
 
 
 def run_paths(paths: Iterable[str],
-              rules: Optional[List[Rule]] = None
+              rules: Optional[List[Rule]] = None,
+              cache: Optional[Any] = None
               ) -> Tuple[List[Finding], int]:
-    """Lint every .py under ``paths``; returns (findings, files_checked).
+    """Lint every .py under ``paths``: per-module rules file by file,
+    then the whole-program call-graph pass (:mod:`callgraph`) over every
+    module's summary, so tracedness/donation/static-argnum/key facts
+    flow across module boundaries. Returns (findings, files_checked).
+
+    ``cache`` (an :class:`cache.AnalysisCache`) skips the parse and the
+    per-module rules for files whose content hash is unchanged — the
+    cached entry carries the module SUMMARY the graph pass consumes, so
+    cross-module findings stay exact (they are recomputed from the
+    summaries every run; only the per-file work is memoized).
+
     Unparseable files surface as ``parse-error`` findings (they gate —
     code the analyzer cannot read is code nothing can vouch for)."""
+    from . import callgraph
+
     rules = rules if rules is not None else all_rules()
+    codes = sorted(r.code for r in rules)
     findings: List[Finding] = []
+    summaries: Dict[str, Any] = {}
     n = 0
     for path in iter_py_files(paths):
         n += 1
         try:
-            with open(path, "r", encoding="utf-8") as fh:
-                source = fh.read()
-            module = Module(path, source)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError as e:
+            findings.append(Finding(
+                rule="GL000-parse-error", path=path, line=1, col=1,
+                message=f"could not parse: {e}", snippet=""))
+            continue
+        sha = hashlib.sha1(raw).hexdigest()
+        entry = cache.get(path, sha, codes) if cache is not None else None
+        if entry is not None:
+            summary = callgraph.ModuleSummary.from_dict(entry["summary"])
+            # the entry may have been written under a different path
+            # SPELLING (relative CLI run vs absolute gate run); re-key to
+            # this run's spelling so graph fids and report paths agree
+            summary.path = path
+            summaries[path] = summary
+            findings.extend(Finding(**{**f, "path": path})
+                            for f in entry["findings"]
+                            if f["rule"] in codes
+                            or f["rule"] == "GL000-parse-error")
+            continue
+        try:
+            module = Module(path, raw.decode("utf-8"))
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
             findings.append(Finding(
                 rule="GL000-parse-error", path=path,
                 line=getattr(e, "lineno", None) or 1, col=1,
                 message=f"could not parse: {e}", snippet=""))
             continue
+        local: List[Finding] = []
         for rule in rules:
-            findings.extend(rule.run(module))
+            local.extend(rule.run(module))
+        summary = callgraph.summarize_module(module)
+        summaries[path] = summary
+        findings.extend(local)
+        if cache is not None:
+            cache.put(path, sha, codes, summary.to_dict(),
+                      [dataclasses.asdict(f) for f in local])
+    graph = callgraph.CallGraph(summaries)
+    for rule in rules:
+        findings.extend(rule.run_graph(graph))
+    if cache is not None:
+        cache.save()
     return _assign_indices(findings), n

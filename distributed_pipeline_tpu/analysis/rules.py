@@ -36,13 +36,30 @@ GL010 unattributed-flops   a FLOPs/MFU figure computed from raw numeric
                            the roofline cost ledger; derive through
                            transformer_train_flops_per_token /
                            active_param_count / roofline_attribution
+GL011 cross-module-key-reuse  the same PRNG key flowing into two
+                           (transitively proven) key-consuming callees,
+                           consumed after a split across a call
+                           boundary, or consumed by a callee every loop
+                           iteration without rebinding — the reuse
+                           GL001 cannot see because the consumers live
+                           behind calls (graph-only rule)
+
+Interprocedural halves (callgraph.py, ISSUE 15): GL002, GL003, GL005
+and GL007 each carry a ``check_graph`` in addition to their per-module
+``check`` — tracedness, donation liveness, and static-argnum facts flow
+across call and module boundaries through the whole-program summary
+fixpoint, turning the three audited blind spots (transitive host syncs,
+cross-module donation-after-use, distant static_argnums) from
+heuristics into proofs. Unknown callees widen to "don't know": the
+graph half only reports what the whole chain proves.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
+from . import callgraph
 from .core import Finding, Module, Rule, register
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -101,19 +118,13 @@ class _KeyState:
 
 
 # jax.random members that DERIVE keys rather than consuming entropy
-_KEY_DERIVERS = {"PRNGKey", "key", "fold_in", "key_data", "wrap_key_data",
-                 "clone", "key_impl"}
+# (one owner: callgraph.py shares these tables with the graph pass)
+_KEY_DERIVERS = callgraph.KEY_DERIVERS
 # callables through which passing a key is not a (countable) consumption
 _KEY_TRANSPARENT = {"jax.eval_shape", "jax.device_put", "jax.tree_util.tree_map",
                     "jax.tree.map", "jax.block_until_ready", "len", "print",
                     "isinstance", "type", "repr", "str", "jax.ShapeDtypeStruct"}
-_KEY_PARAM_PAT = ("rng", "key", "prng", "seed_key")
-
-
-def _is_key_param(name: str) -> bool:
-    low = name.lower()
-    return any(low == p or low.endswith("_" + p) or low.startswith(p + "_")
-               or low.rstrip("0123456789") == p for p in _KEY_PARAM_PAT)
+_is_key_param = callgraph.is_key_param
 
 
 @register
@@ -295,12 +306,9 @@ class KeyReuse(Rule):
 
 # numpy members that force (or silently constant-fold) a host round-trip
 # when handed a tracer; shape/constant builders (arange/zeros/linspace...)
-# stay legal — they consume static python values.
-_SYNC_NP = {"asarray", "array", "sum", "mean", "std", "var", "max", "min",
-            "argmax", "argmin", "any", "all", "allclose", "isnan",
-            "isfinite", "isinf", "where", "concatenate", "stack", "dot",
-            "matmul", "prod", "abs", "clip", "sqrt", "exp", "log",
-            "float32", "float64", "int32", "int64"}
+# stay legal — they consume static python values. (Shared table:
+# callgraph.py uses the same set for the transitive half.)
+_SYNC_NP = callgraph.SYNC_NP
 
 
 @register
@@ -308,11 +316,21 @@ class HostSync(Rule):
     """GL002: device->host synchronization inside traced code —
     ``.item()``, ``float()/int()/bool()`` on non-literals, numpy ops, and
     explicit ``device_get``/``block_until_ready`` all either fail at trace
-    time or (worse) silently freeze a traced value at trace time."""
+    time or (worse) silently freeze a traced value at trace time.
+
+    Graph half (PROVEN, not lexical): a helper whose parameter-rooted
+    host sync is reached from any traced context through an
+    interprocedurally resolved call chain — across modules — is flagged
+    at the sync site with the traced caller as witness."""
 
     code = "GL002-host-sync"
-    description = ("host sync inside jit/scan-traced code: .item(), "
-                   "float()/int(), np.*, device_get, block_until_ready")
+    description = ("host sync inside jit/scan-traced code (or in a "
+                   "helper any traced context reaches transitively): "
+                   ".item(), float()/int(), np.*, device_get, "
+                   "block_until_ready")
+
+    def check_graph(self, graph: Any) -> Iterator[Finding]:
+        return graph.iter_transitive_host_syncs(self)
 
     def check(self, module: Module) -> Iterator[Finding]:
         for node in ast.walk(module.tree):
@@ -363,7 +381,13 @@ class DonationAfterUse(Rule):
 
     code = "GL003-donation-after-use"
     description = ("argument donated via donate_argnums is read after "
-                   "the donating call")
+                   "the donating call — including donors imported from "
+                   "another module or helpers that transitively donate")
+
+    def check_graph(self, graph: Any) -> Iterator[Finding]:
+        # cross-module donors (imported jitted bindings, helpers that
+        # transitively donate a parameter) — the r6 orbax-restore shape
+        return graph.iter_cross_module_donations(self)
 
     def check(self, module: Module) -> Iterator[Finding]:
         if not module.donations:
@@ -500,11 +524,21 @@ class RecompileHazard(Rule):
     wrapper built per loop iteration, and shape-derived Python scalars
     (``len(x)``, ``x.shape``) or per-step-varying f-strings flowing into
     a jitted call's traced arguments (each new value = a full retrace;
-    the r6 hidden step-2 recompile class)."""
+    the r6 hidden step-2 recompile class).
+
+    The rule is static-argnum aware in BOTH halves: an argument the
+    ``jax.jit``/``functools.partial`` site declares static (by position
+    or name) is supposed to vary — no finding. The graph half resolves
+    jitted bindings imported from other modules (including through
+    re-exports and partial chains), closing the "static_argnums declared
+    far from the call site" blind spot in both directions: a distant
+    declaration suppresses the false positive, and a distant jitted
+    binding called with a hazard argument is now caught at all."""
 
     code = "GL005-recompile-hazard"
     description = ("recompile hazard: jit built inside a loop, or "
-                   "len()/.shape/f-string values passed to a jitted call")
+                   "len()/.shape/f-string values passed NON-STATIC into "
+                   "a jitted binding (local or imported)")
 
     def check(self, module: Module) -> Iterator[Finding]:
         for node in ast.walk(module.tree):
@@ -527,28 +561,59 @@ class RecompileHazard(Rule):
                 continue
             if callee not in module.jitted_bindings:
                 continue
-            for arg in list(node.args) + [k.value for k in node.keywords]:
+            info = module.jit_info.get(callee, {})
+            argnums = {int(x) for x in info.get("static_argnums", ())}
+            argnames = set(info.get("static_argnames", ()))
+            wrapped_params = self._wrapped_params(module, info)
+            for i, arg in enumerate(node.args):
                 hazard = self._scalar_hazard(arg)
-                if hazard:
-                    yield module.finding(
-                        self, arg, f"{hazard} flows into jitted call "
-                        f"'{callee}' as a traced argument — every new "
-                        "value retraces and recompiles; mark it static "
-                        "(static_argnums) or derive it inside the jit")
+                if not hazard:
+                    continue
+                pname = (wrapped_params[i]
+                         if i < len(wrapped_params) else None)
+                if i in argnums or (pname and pname in argnames):
+                    continue  # declared static: supposed to vary
+                yield self._hazard(module, arg, hazard, callee)
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                hazard = self._scalar_hazard(kw.value)
+                if not hazard:
+                    continue
+                if kw.arg in argnames or (
+                        kw.arg in wrapped_params
+                        and wrapped_params.index(kw.arg) in argnums):
+                    continue
+                yield self._hazard(module, kw.value, hazard, callee)
+
+    def check_graph(self, graph: Any) -> Iterator[Finding]:
+        # jitted bindings resolved across module boundaries, with the
+        # distant static_argnums/static_argnames honored
+        return graph.iter_distant_static_hazards(self)
+
+    def _hazard(self, module: Module, arg: ast.AST, hazard: str,
+                callee: str) -> Finding:
+        return module.finding(
+            self, arg, f"{hazard} flows into jitted call "
+            f"'{callee}' as a traced argument — every new "
+            "value retraces and recompiles; mark it static "
+            "(static_argnums) or derive it inside the jit")
 
     @staticmethod
-    def _scalar_hazard(arg: ast.AST) -> Optional[str]:
-        if isinstance(arg, ast.JoinedStr):
-            return "an f-string (fresh object per call)"
-        for n in ast.walk(arg):
-            if isinstance(n, _FUNC_NODES):
-                return None
-            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
-                    and n.func.id == "len":
-                return "a len() python scalar"
-            if isinstance(n, ast.Attribute) and n.attr == "shape":
-                return "a .shape-derived python value"
-        return None
+    def _wrapped_params(module: Module, info: dict) -> List[str]:
+        """Positional parameter names of the function the binding
+        wraps, when it is a plain local def (maps static_argnames to
+        positions and vice versa); [] when unknown."""
+        target = info.get("target")
+        if not target or "." in target:
+            return []
+        defs = module.defs_by_name.get(target, ())
+        for d in defs:
+            a = d.args
+            return [p.arg for p in a.posonlyargs + a.args]
+        return []
+
+    _scalar_hazard = staticmethod(callgraph._scalar_hazard)
 
 
 # --------------------------------------------------------------------- GL006
@@ -604,11 +669,12 @@ class RawShardMap(Rule):
 # --------------------------------------------------------------------- GL007
 
 # conversions that block the host on an in-flight device value
-_GL007_NP_BLOCKERS = {"numpy.asarray", "numpy.array"}
-_GL007_BUILTINS = {"float", "int", "bool"}
+# (shared tables: callgraph.py uses the same sets for the graph half)
+_GL007_NP_BLOCKERS = callgraph.NP_BLOCKERS
+_GL007_BUILTINS = callgraph.BLOCKING_BUILTINS
 # method names whose call result is (very likely) a jitted step's output:
 # the trainer's own loop surface plus the conventional step-fn spellings
-_GL007_STEP_ATTRS = {"run_step", "forward_only", "train_step", "eval_step"}
+_GL007_STEP_ATTRS = callgraph.STEP_ATTRS
 
 
 def _root_name(node: ast.AST) -> Optional[str]:
@@ -636,7 +702,13 @@ class HostSyncInLoop(Rule):
     code = "GL007-host-sync-in-loop"
     description = ("blocking conversion (float()/np.asarray/.item()) of a "
                    "jitted step's output inside the outer training loop "
-                   "serializes async dispatch")
+                   "— directly or through a helper that transitively "
+                   "blocks on its argument — serializes async dispatch")
+
+    def check_graph(self, graph: Any) -> Iterator[Finding]:
+        # a loop handing a step output to a helper that (transitively)
+        # float()s/.item()s it — the hop the lexical rule cannot see
+        return graph.iter_loop_blocking_calls(self)
 
     def check(self, module: Module) -> Iterator[Finding]:
         reported: Set[int] = set()
@@ -1038,3 +1110,30 @@ class UnattributedFlops(Rule):
                         return n
             stack.extend(ast.iter_child_nodes(n))
         return None
+
+
+# --------------------------------------------------------------------- GL011
+
+
+@register
+class CrossModuleKeyReuse(Rule):
+    """GL011: the same PRNG key flowing into two key-consuming callees
+    (graph-only rule — the whole point is that the consumers live behind
+    calls, often in other modules). GL001 deliberately does not count a
+    key-named parameter passed to an arbitrary call — without knowing
+    the callee, that would drown the report in maybes. The call graph
+    removes the guesswork: a callee parameter is *proven* key-consuming
+    when a ``jax.random`` sampler (or split) reaches it transitively, so
+    the replay can count those calls as consumptions exactly. Flags:
+    two consumptions of one key where at least one crosses a proven
+    callee; consumption after ``jax.random.split`` across a call
+    boundary; and a proven consumer called every loop iteration on a
+    key from outside the loop without rebinding."""
+
+    code = "GL011-cross-module-key-reuse"
+    description = ("same PRNG key consumed by two (transitively proven) "
+                   "key-consuming callees across call/module boundaries "
+                   "— correlated randomness GL001 cannot see")
+
+    def check_graph(self, graph: Any) -> Iterator[Finding]:
+        return graph.iter_cross_module_key_reuse(self)

@@ -353,6 +353,8 @@ def collect_sources(d: str) -> List[Tuple[int, str, List[dict]]]:
             if not any(label.startswith("launcher") for label, _ in shards):
                 events.extend(_attempt_events(rd))
             events.extend(_beacon_events(rd).values())
+            # per-replica roofline counter tracks (--cost_ledger workers)
+            events.extend(_ledger_events(rd))
             sources.append((10 + rid, f"replica_{rid}", events))
         return sources
     rank_shards: Dict[int, List[dict]] = {}
@@ -496,6 +498,14 @@ def _prom_run(p: _Prom, run_dir: str, now: float,
             p.add("dpt_goodput_seconds", agg[cat],
                   {**(labels or {}), "category": cat[:-2]},
                   help_="goodput ledger decomposition (seconds)")
+    _prom_ledger(p, run_dir, labels)
+
+
+def _prom_ledger(p: _Prom, run_dir: str,
+                 labels: Optional[dict] = None) -> None:
+    """perf_ledger.json -> dpt_mfu/gap gauges. One owner shared by the
+    training-run and per-replica fleet snapshots (a replica worker with
+    --cost_ledger writes the same file into its replica dir)."""
     led = ledger_lib.read_ledger(run_dir)
     for name, row in sorted(((led or {}).get("programs") or {}).items()):
         if "mfu" not in row:
@@ -549,6 +559,9 @@ def _prom_fleet(p: _Prom, fleet_dir: str, now: float) -> None:
         attempts = goodput.read_attempts(rd)
         if attempts:
             p.add("dpt_replica_attempts_total", len(attempts), lab)
+        # per-replica roofline: a --cost_ledger replica worker snapshots
+        # perf_ledger.json into its replica dir (ISSUE 15 satellite)
+        _prom_ledger(p, rd, lab)
     events = read_trace(goodput.serving_journal_path(fleet_dir))
     if events:
         counts = journal_counts(events)

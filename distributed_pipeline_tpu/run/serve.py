@@ -46,6 +46,23 @@ def create_parser() -> argparse.ArgumentParser:
     return ServeSettings.to_argparse()
 
 
+# worker argv: every serve setting EXCEPT the fleet-parent-only knobs
+# (the fleet appends --fleet_worker_dir/--replica_id per replica). One
+# owner, jax-free, so the argv plumbing is unit-testable: anything added
+# to ServeSettings — e.g. cost_ledger — reaches the replica workers.
+_PARENT_ONLY = {"replicas", "fleet_dir", "fleet_worker_dir",
+                "replica_id", "out", "prompt_file"}
+
+
+def _worker_argv(settings: ServeSettings) -> list:
+    argv = []
+    for name in type(settings).model_fields:
+        if name in _PARENT_ONLY:
+            continue
+        argv += [f"--{name}", str(getattr(settings, name))]
+    return argv
+
+
 def _load_requests(settings: ServeSettings, max_prompt_len: int,
                    vocab_size: int):
     """(prompt int32 [L], max_new_tokens) pairs from the prompt file, or a
@@ -326,11 +343,38 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     # Warmup BEFORE announcing ready: the prefill/decode AOT compiles run
     # here, so the first routed request's TTFT is service time, not
     # compile time — and the watchdog (armed by the FIRST beacon) never
-    # sees compilation as a hang.
-    warm = server.submit(np.full((2,), 4, np.int32), max_new_tokens=1)
+    # sees compilation as a hang. max_new_tokens=2: the FIRST token
+    # comes out of prefill, so a 1-token warmup never dispatched (or
+    # compiled) the decode executable — the first routed request then
+    # paid the decode compile, and an idle replica's cost ledger had no
+    # decode row.
+    warm = server.submit(np.full((2,), 4, np.int32), max_new_tokens=2)
     server.drain()
     del warm
     server.reset_stats()
+
+    # Per-replica cost ledger (r16 NOTE closed): --cost_ledger makes the
+    # worker snapshot its roofline attribution into <replica>/perf_ledger
+    # .json — the same file/shape a training run dir carries — so
+    # run/status.py and obs/export.py surface per-replica MFU live.
+    t_serve0 = time.perf_counter()
+    last_ledger = [0.0]
+
+    def _write_ledger(force: bool = False) -> None:
+        if not settings.cost_ledger:
+            return
+        now = time.perf_counter()
+        if not force and now - last_ledger[0] < 2.0:
+            return  # snapshot cadence: the ledger is telemetry, not a
+            # per-tick obligation on the decode hot path
+        last_ledger[0] = now
+        from ..obs import ledger as ledger_lib
+        try:
+            rows = server.cost_ledger(wall_s=now - t_serve0, n_devices=1)
+            ledger_lib.write_ledger(paths.root, rows, t=time.time())
+        except Exception as e:  # telemetry must never kill the replica
+            print(f"[serve-worker {rid}] ledger write failed: {e}",
+                  file=sys.stderr, flush=True)
 
     tick = 0
     admitted = 0
@@ -419,6 +463,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
             _report_done()
             tick += 1
             proto.write_beacon(tick)
+            _write_ledger()
             if not moved:
                 time.sleep(0.005)
     finally:
@@ -430,6 +475,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
             tick += 1
             proto.write_beacon(tick)
     _report_done()
+    _write_ledger(force=True)  # final snapshot covers the whole attempt
     proto.tracer.close()
     summary = {"ticks": tick, "admitted": admitted, "completed": completed,
                "tokens": tokens_out, "params_step": current_step[0],
@@ -481,16 +527,7 @@ def _fleet_main(settings: ServeSettings) -> dict:
     injector = (ChaosInjector(plan, rank=0, run_dir=fleet_dir)
                 if plan else None)
 
-    # worker argv: every serve setting EXCEPT the fleet-parent-only knobs
-    # (the fleet appends --fleet_worker_dir/--replica_id per replica)
-    parent_only = {"replicas", "fleet_dir", "fleet_worker_dir",
-                   "replica_id", "out", "prompt_file"}
-    argv = []
-    for name in type(settings).model_fields:
-        if name in parent_only:
-            continue
-        value = getattr(settings, name)
-        argv += [f"--{name}", str(value)]
+    argv = _worker_argv(settings)
 
     # Replica backend: 'auto' = the parent's own platform selection
     # (JAX_PLATFORMS in this jax-free parent's env — "cpu" under every

@@ -125,6 +125,10 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
             state = "starting"
         else:
             state = "ready"
+        # per-replica roofline (the replica's --cost_ledger snapshot in
+        # its own run dir — ISSUE 15 satellite): decode-phase MFU live
+        led = ledger_lib.read_ledger(rd)
+        dec = (led or {}).get("programs", {}).get("serve_decode") or {}
         rows.append({
             "replica": rid,
             "state": state,
@@ -136,6 +140,12 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
             "serving_s": snap.get("serving_s"),
             "drain_s": snap.get("drain_s"),
             "swap_s": snap.get("swap_s"),
+            "mfu": (round(float(dec["mfu"]), 4)
+                    if isinstance(dec.get("mfu"), (int, float))
+                    else None),
+            "tokens_per_s": (round(float(dec["tokens_per_s"]), 1)
+                             if isinstance(dec.get("tokens_per_s"),
+                                           (int, float)) else None),
             "attempts": len(goodput.read_attempts(rd)),
         })
     events = goodput.read_journal(goodput.serving_journal_path(fleet_dir))
@@ -176,7 +186,7 @@ def render(snap: dict) -> str:
     if snap["kind"] == "fleet":
         headers = ["replica", "state", "attempt", "params_step", "tick",
                    "beacon_age_s", "in_flight", "serving_s", "drain_s",
-                   "swap_s", "attempts"]
+                   "swap_s", "mfu", "tokens_per_s", "attempts"]
         out.append(_table(headers, [[r.get(h) for h in headers]
                                     for r in snap["replicas"]]))
         out.append(

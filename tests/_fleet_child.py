@@ -21,6 +21,7 @@ worker.
 
 Argv: --fleet_worker_dir DIR --replica_id I --checkpoint_dir CKPTS
       [--step N] [--token_interval_s S] [--startup_s S]
+      [--cost_ledger true|false]
 """
 
 import argparse
@@ -35,6 +36,7 @@ parser.add_argument("--checkpoint_dir", required=True)
 parser.add_argument("--step", type=int, default=1)
 parser.add_argument("--token_interval_s", type=float, default=0.003)
 parser.add_argument("--startup_s", type=float, default=0.0)
+parser.add_argument("--cost_ledger", default="false")
 ns = parser.parse_args()
 
 from distributed_pipeline_tpu.chaos import (  # noqa: E402
@@ -73,6 +75,28 @@ admitted = 0
 completed = 0
 tokens_out = 0
 in_flight = {}  # id -> [payload, tokens]
+t_serve0 = time.time()
+
+
+def write_ledger():
+    """Mirror of the real worker's --cost_ledger snapshot: the same
+    perf_ledger.json file/row shape in the replica dir (mfu + gap terms
+    summing to 1 by construction), so the status/export surfacing is
+    provable over a real fleet ring without paying a jax import."""
+    if ns.cost_ledger.strip().lower() not in ("true", "1", "yes"):
+        return
+    from distributed_pipeline_tpu.obs import ledger as ledger_lib
+    wall = max(time.time() - t_serve0, 1e-6)
+    mfu = 0.01 * (1 + ns.replica_id)
+    row = {"program": "serve_decode", "mfu": mfu,
+           "tokens_per_s": tokens_out / wall,
+           "collective_bytes_per_step": 0.0,
+           "padding_waste_frac": 0.25}
+    gaps = dict.fromkeys(ledger_lib.GAP_TERMS, 0.0)
+    gaps["mfu_gap_residual"] = 1.0 - mfu
+    row.update(gaps)
+    ledger_lib.write_ledger(ns.fleet_worker_dir, {"serve_decode": row},
+                            t=time.time())
 
 
 def token_fn(prompt, k: int) -> int:
@@ -112,6 +136,7 @@ def step_decode() -> bool:
 
 proto.write_beacon(tick)
 proto.announce_ready(cur_step)
+write_ledger()
 
 while not proto.stop_requested():
     cmd = proto.pending_swap()
@@ -149,6 +174,7 @@ with proto.tracker.timed("drain_s"):
         step_decode()
         tick += 1
         proto.write_beacon(tick)
+write_ledger()
 proto.write_sidecar({"ticks": tick, "admitted": admitted,
                      "completed": completed, "tokens": tokens_out,
                      "params_step": cur_step})

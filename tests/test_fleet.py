@@ -501,12 +501,13 @@ def _expected_tokens(prompt, n, salt):
 
 
 def _start_fleet(tmp_path, n, ckpt_dir, *, token_interval=0.01,
-                 hang_timeout_s=0.0, max_restarts=3, stale_beacon_s=10.0):
+                 hang_timeout_s=0.0, max_restarts=3, stale_beacon_s=10.0,
+                 extra_argv=()):
     fleet_dir = str(tmp_path / "fleet")
     fleet = ServingFleet(
         fleet_dir, n, "tests._fleet_child",
         ["--checkpoint_dir", str(ckpt_dir), "--step", "1",
-         "--token_interval_s", str(token_interval)],
+         "--token_interval_s", str(token_interval), *extra_argv],
         hang_timeout_s=hang_timeout_s, max_restarts=max_restarts,
         restart_backoff_s=0.1, restart_backoff_max_s=0.5,
         monitor_interval=0.02)
@@ -712,6 +713,71 @@ def test_fleet_corrupt_swap_aborts_with_old_weights(tmp_path, monkeypatch):
         assert read_json_file(fleet.paths[rid].current_path) is None
         ready = read_json_file(fleet.paths[rid].ready_path)
         assert ready["params_step"] == 1
+
+
+# =================================== per-replica cost ledger (ISSUE 15)
+
+def test_worker_argv_carries_cost_ledger():
+    """r16 NOTE closed: ServeSettings.cost_ledger rides the fleet
+    worker argv (one owner, jax-free), and the rendered value parses
+    back to True through the settings bool coercion."""
+    from distributed_pipeline_tpu.config.serve import ServeSettings
+    from distributed_pipeline_tpu.run.serve import _worker_argv
+
+    s = ServeSettings.from_argv(
+        ["--checkpoint_path", "/tmp/run", "--replicas", "2",
+         "--cost_ledger", "true"])
+    argv = _worker_argv(s)
+    assert "--cost_ledger" in argv
+    assert argv[argv.index("--cost_ledger") + 1] == "True"
+    # parent-only knobs must NOT reach the worker
+    for flag in ("--replicas", "--fleet_dir", "--out", "--prompt_file"):
+        assert flag not in argv
+    # the worker re-parses the argv it will actually receive
+    s2 = ServeSettings.from_argv(
+        argv + ["--fleet_worker_dir", "/tmp/f/replica_0",
+                "--replica_id", "0"])
+    assert s2.cost_ledger is True and s2.replica_id == 0
+
+
+@pytest.mark.chaos
+def test_fleet_per_replica_ledger_surfaces(tmp_path):
+    """--cost_ledger fleet ring: every replica snapshots a
+    perf_ledger.json into its replica dir, and the read-only surfaces
+    — run/status.py fleet rows, the Prometheus snapshot, the Perfetto
+    export — carry the per-replica rooflines."""
+    from distributed_pipeline_tpu.obs import export as export_lib
+    from distributed_pipeline_tpu.obs import ledger as ledger_lib
+    from distributed_pipeline_tpu.run.status import fleet_status
+
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=3)
+    fleet, router = _start_fleet(tmp_path, 2, ckpt,
+                                 extra_argv=("--cost_ledger", "true"))
+    try:
+        for i in range(4):
+            router.submit(np.arange(i + 1, i + 4, dtype=np.int32), 6)
+        _drive(router, fleet)
+    finally:
+        fleet.stop()
+    fleet_dir = str(tmp_path / "fleet")
+    for rid in range(2):
+        led = ledger_lib.read_ledger(goodput.replica_dir(fleet_dir, rid))
+        assert led is not None, f"replica {rid} wrote no perf_ledger"
+        row = led["programs"]["serve_decode"]
+        assert ledger_lib.gap_sum_identity(row) == pytest.approx(1.0)
+    snap = fleet_status(fleet_dir)
+    by_rid = {r["replica"]: r for r in snap["replicas"]}
+    assert by_rid[0]["mfu"] == pytest.approx(0.01)
+    assert by_rid[1]["mfu"] == pytest.approx(0.02)
+    assert by_rid[0]["tokens_per_s"] is not None
+    prom = "\n".join(export_lib.prometheus_lines(fleet_dir))
+    assert 'dpt_mfu{program="serve_decode",replica="0"}' in prom
+    assert 'dpt_mfu{program="serve_decode",replica="1"}' in prom
+    trace = export_lib.chrome_trace(fleet_dir)
+    roof = [ev for ev in trace["traceEvents"]
+            if ev.get("name") == "roofline serve_decode"]
+    assert len(roof) >= 2  # one counter track sample per replica
 
 
 # ============================================== settings + real-model e2e

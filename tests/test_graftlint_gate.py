@@ -8,7 +8,9 @@ import os
 
 import pytest
 
-from distributed_pipeline_tpu.analysis import Baseline, run_paths
+from distributed_pipeline_tpu.analysis import AnalysisCache, Baseline, \
+    run_paths
+from distributed_pipeline_tpu.analysis.cache import CACHE_NAME
 
 pytestmark = pytest.mark.lint
 
@@ -47,14 +49,23 @@ GATED_PATHS = [
     # the cost-ledger tests drive TrainLoop/DecodeServer outer loops
     # (GL007) and are exactly where inline FLOPs math would breed (GL010)
     os.path.join(ROOT, "tests", "test_ledger.py"),
+    # the analysis tests themselves: their helper code drives the
+    # linter's own surfaces, and gating them keeps the fixture-builder
+    # helpers honest against every rule
+    os.path.join(ROOT, "tests", "test_analysis.py"),
 ]
 
 
 @pytest.fixture(scope="module")
 def gate_run():
-    """One lint of the gated paths shared by the gate tests (the full
-    AST pass over 45+ files costs ~2s — no reason to pay it twice)."""
-    return run_paths(GATED_PATHS)
+    """One lint of the gated paths shared by the gate tests, through the
+    content-hash cache beside the baseline (ISSUE 15 satellite: the
+    gated path list grows every PR — unchanged modules must not be
+    reparsed on every `pytest -m lint` run). The cache can only memoize
+    per-file work; the cross-module pass recomputes from summaries, so
+    a warm cache changes wall time, never findings."""
+    cache = AnalysisCache(os.path.join(ROOT, CACHE_NAME))
+    return run_paths(GATED_PATHS, cache=cache)
 
 
 def test_committed_baseline_exists_and_is_valid():
