@@ -895,6 +895,214 @@ def main() -> None:
             "leg_wall_s": round(wall, 1),
         }
 
+    def measure_mpmd_pipe(name: str, *, steps: int = 3, n_stages: int = 2,
+                          n_microbatches: int = 4, batch: int = 8,
+                          seq_len: int = 128, hidden: int = 64,
+                          layers: int = 4, heads: int = 4,
+                          hang_timeout_s: float = 120.0):
+        """MPMD pipeline-training leg (ISSUE 16): the host-driven 1F1B
+        driver runs a 2-stage diffuseq pipeline where EACH STAGE is its
+        own supervised launcher ring (always CPU rings — like every
+        robustness leg this measures the substrate, not the chip) and
+        activations/grads move over the StageLink host relay. Acceptance:
+        every step's loss finite with zero rewinds, the per-stage attempt
+        ledgers folding to accounted_frac == 1.0 with the ``link_wait``
+        category present, and zero steady-state recompiles on every
+        stage."""
+        import shutil
+
+        from distributed_pipeline_tpu.mpmd import PipelineDriver
+        from distributed_pipeline_tpu.run.status import pipeline_status
+
+        run_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", "mpmd_pipe"))
+        shutil.rmtree(run_dir, ignore_errors=True)
+        config = {
+            "n_stages": n_stages,
+            "n_microbatches": n_microbatches,
+            "schedule": "1f1b",
+            "model": dict(model_family="diffuseq", vocab_size=128,
+                          seq_len=seq_len, hidden_size=hidden,
+                          num_layers=layers, num_heads=heads,
+                          diffusion_steps=50, dtype="float32",
+                          scan_layers=True),
+            "data": dict(dataset="synthetic-seq2seq", seq_len=seq_len,
+                         vocab_size=128, seed=0),
+            "batch_size": batch,
+            "seed": 0,
+            "lr": 1e-3,
+            "link_capacity": 8,
+        }
+        driver = PipelineDriver(run_dir, config, max_restarts=1,
+                                hang_timeout_s=hang_timeout_s,
+                                worker_platform="cpu")
+        t0 = time.perf_counter()
+        try:
+            res = driver.run(steps)
+        finally:
+            driver.stop()
+        wall = time.perf_counter() - t0
+        gp = res.get("goodput") or {}
+        snap = pipeline_status(run_dir)
+        steady = [r.get("steady_recompiles") for r in snap.get("stages", [])]
+        failures = []
+        losses = res.get("losses") or []
+        if len(losses) != steps or any(l != l for l in losses):
+            failures.append(f"bad loss stream: {losses}")
+        if res.get("rewinds"):
+            failures.append(f"{res['rewinds']} rewinds on a fault-free run")
+        if abs(gp.get("accounted_frac", 0.0) - 1.0) > 0.05:
+            failures.append(
+                f"ledger unaccounted (frac={gp.get('accounted_frac')})")
+        if "link_wait_s" not in gp:
+            failures.append("no link_wait category in the pipeline fold")
+        if any(s not in (0, None) for s in steady):
+            failures.append(f"steady-state recompiles: {steady}")
+        if failures:
+            return {"name": name, "error": "; ".join(failures)[:500],
+                    "leg_wall_s": round(wall, 1)}
+        return {
+            "name": name,
+            "n_stages": n_stages,
+            "schedule": "1f1b",
+            "n_microbatches": n_microbatches,
+            "steps": steps,
+            "final_loss": round(float(losses[-1]), 4),
+            "rewinds": res.get("rewinds"),
+            "attempts_per_stage": res.get("attempts_per_stage"),
+            "goodput": round(gp.get("goodput", 0.0), 4),
+            "link_wait_s": round(gp.get("link_wait_s", 0.0), 3),
+            "accounted_frac": gp.get("accounted_frac"),
+            "steady_recompile_count": sum(int(s or 0) for s in steady),
+            "steps_per_s": round(steps / wall, 4) if wall > 0 else None,
+            "leg_wall_s": round(wall, 1),
+        }
+
+    def measure_serve_disagg(name: str, *, requests: int = 8,
+                             gen_tokens: int = 6, prompt_len: int = 6,
+                             page_size: int = 4, seq_len: int = 32,
+                             decode_slots: int = 2, rate_rps: float = 6.0,
+                             burst_size: int = 4,
+                             hang_timeout_s: float = 60.0,
+                             timeout_s: float = 200.0):
+        """Disaggregated prefill/decode serving leg (ISSUE 16): one
+        prefill replica streams paged-KV frames over the StageLink host
+        relay to a DecodeServer on a separate worker process, admitted
+        through the same router as the colocated legs. A BURSTY arrival
+        pattern front-loads prefill work so the leg's TTFT reads against
+        the colocated gpt2-serve-decode-b8 row under comparable queueing
+        pressure. Acceptance: every admitted request completes, zero
+        drops, and BOTH tiers' goodput ledgers account every
+        replica-second (accounted_frac == 1.0). No steady-recompile
+        claim: DecodeServer.submit_prefilled ingests page batches whose
+        fill count varies per prompt, so decode-side compile counts are
+        shape-dependent by design."""
+        import shutil
+        import subprocess
+
+        run_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", "disagg_run"))
+        shutil.rmtree(run_dir, ignore_errors=True)
+        dims = dict(hidden_size=32, num_layers=2, num_heads=2,
+                    vocab_size=64)
+        wl = create_model_from_config(
+            model_family="gpt2", model_size="base", seq_len=seq_len,
+            dtype="float32", **dims)
+        data = load_data_from_args(
+            "train", batch_size=8, dataset="synthetic-lm",
+            seq_len=seq_len, vocab_size=dims["vocab_size"], seed=0)
+        loop = TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                         ema_rate="0.99", learning_steps=0,
+                         log_interval=10 ** 9, save_interval=10 ** 9,
+                         checkpoint_dir=run_dir)
+        for _ in range(2):
+            loop.run_step(next(loop.data))
+        loop.save()
+        loop.wait_for_saves()
+        with open(os.path.join(run_dir, "training_args.json"), "w") as f:
+            json.dump(dict(model_family="gpt2", model_size="base",
+                           seq_len=seq_len, dtype="float32",
+                           dataset="synthetic-lm", seed=0, **dims), f)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)  # workers size their own
+        fleet_dir = os.path.join(run_dir, "fleet")
+        cmd = [sys.executable, "-m", "distributed_pipeline_tpu.run.serve",
+               "--checkpoint_path", run_dir, "--step", "2",
+               "--replicas", "1", "--disagg", "1",
+               "--fleet_dir", fleet_dir,
+               "--decode_slots", str(decode_slots),
+               "--page_size", str(page_size),
+               "--max_prompt_len", str(max(8, prompt_len + 2)),
+               "--max_new_tokens", str(gen_tokens),
+               "--traffic", "bursty", "--rate_rps", str(rate_rps),
+               "--burst_size", str(burst_size),
+               "--synthetic_requests", str(requests),
+               "--synthetic_prompt_len", str(prompt_len),
+               "--hang_timeout_s", str(hang_timeout_s),
+               "--fleet_deadline_s", str(max(30.0, timeout_s - 25.0))]
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            return {"name": name,
+                    "error": f"disagg run exceeded its {timeout_s:.0f}s "
+                             f"timeout"}
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0 or not out.strip():
+            return {"name": name,
+                    "error": f"disagg run failed (rc={proc.returncode}): "
+                             f"{(err or out or '')[-300:]}"}
+        res = json.loads(out.strip().splitlines()[-1])
+        sgp = res.get("serving_goodput") or {}
+        dgp = res.get("decode_goodput") or {}
+        failures = []
+        if res.get("dropped"):
+            failures.append(f"{res['dropped']} admitted requests dropped")
+        if res.get("completed") != requests:
+            failures.append(f"{res.get('completed')}/{requests} completed")
+        if not res.get("disagg"):
+            failures.append("router did not run in disagg mode")
+        if abs(sgp.get("accounted_frac", 0.0) - 1.0) > 0.05:
+            failures.append(
+                f"prefill ledger unaccounted "
+                f"(frac={sgp.get('accounted_frac')})")
+        if abs(dgp.get("accounted_frac", 0.0) - 1.0) > 0.05:
+            failures.append(
+                f"decode ledger unaccounted "
+                f"(frac={dgp.get('accounted_frac')})")
+        p50, p95 = res.get("ttft_p50_s"), res.get("ttft_p95_s")
+        if p50 is None:
+            failures.append("no TTFT percentiles")
+        if failures:
+            return {"name": name, "error": "; ".join(failures)[:500],
+                    "leg_wall_s": round(wall, 1)}
+        return {
+            "name": name,
+            "disagg": True,
+            "requests": res["requests"],
+            "completed": res["completed"],
+            "dropped": res["dropped"],
+            "ttft_p50_s": p50,
+            "ttft_p95_s": p95,
+            "decode_tokens_per_s": res.get("decode_tokens_per_s"),
+            "prefill_accounted_frac": sgp.get("accounted_frac"),
+            "decode_accounted_frac": dgp.get("accounted_frac"),
+            "traffic": res.get("traffic"),
+            "wall_s": res.get("wall_s"),
+            "leg_wall_s": round(wall, 1),
+        }
+
     def measure_prefetch_ab(name: str, *, family: str, size: str,
                             seq_len: int, batch: int, microbatch: int = 0,
                             window_steps: int = 4, rounds: int = 6,
@@ -1475,6 +1683,25 @@ def main() -> None:
             measure_serve_fleet, "gpt2-serve-fleet-chaos",
             replicas=3, requests=16, rate_rps=2.0, gen_tokens=10,
             kill_after=2, swap_after=5)),
+        # MPMD pipeline leg (ISSUE 16): host-driven 1F1B across two
+        # single-process stage rings with activations/grads over the
+        # StageLink host relay. Acceptance: finite losses with zero
+        # rewinds, the per-stage fold accounting every stage-second
+        # (accounted_frac 1.0, link_wait category present), steady
+        # recompiles 0. Always the CPU substrate shape — this measures
+        # the MPMD runtime, not the chip.
+        ("diffuseq-base-seq128-mpmd-pipe", functools.partial(
+            measure_mpmd_pipe, "diffuseq-base-seq128-mpmd-pipe",
+            steps=3, n_stages=2, n_microbatches=4, batch=8,
+            seq_len=128)),
+        # Disaggregated serving leg (ISSUE 16): prefill tier streams
+        # paged-KV frames over StageLink to a decode tier on a separate
+        # worker, bursty arrivals; TTFT reads against the colocated
+        # gpt2-serve-decode-b8 row. Acceptance: all requests complete,
+        # zero drops, BOTH tiers' ledgers hold accounted_frac 1.0.
+        ("gpt2-serve-disagg", functools.partial(
+            measure_serve_disagg, "gpt2-serve-disagg",
+            requests=8, gen_tokens=6, rate_rps=6.0, burst_size=4)),
         # no-accumulation variant (pure config-2 semantics)
         ("diffuseq-base-seq128-noaccum", functools.partial(
             measure, "diffuseq-base-seq128-noaccum", family="diffuseq",

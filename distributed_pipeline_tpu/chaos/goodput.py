@@ -61,6 +61,7 @@ __all__ = [
     "attempts_path", "append_attempt", "read_attempts",
     "goodput_record_path", "read_goodput_records", "aggregate_run",
     "replica_dir", "replica_id", "list_replica_dirs",
+    "stage_dir", "stage_id", "list_stage_dirs",
     "serving_journal_path",
     "read_journal", "serving_record_path", "read_serving_records",
     "aggregate_serving",
@@ -69,9 +70,11 @@ __all__ = [
 _BEACON_RE = re.compile(r"\.progress_rank(\d+)\.json$")
 
 # Goodput categories summed across attempts (mirrors
-# perf.GoodputTracker.CATEGORIES + the data_stall merged at summary time).
+# perf.GoodputTracker.CATEGORIES + the data_stall merged at summary time;
+# link_wait is the MPMD stages' send/recv-blocked time — mpmd/link.py —
+# booked by the jax-free HostGoodput, zero for single-program attempts).
 _CATEGORIES = ("startup_s", "setup_s", "restore_s", "compile_s", "save_s",
-               "data_stall_s", "recompute_s")
+               "data_stall_s", "recompute_s", "link_wait_s")
 
 
 def beacon_path(run_dir: str, rank: int) -> str:
@@ -190,6 +193,33 @@ def replica_id(replica_dir_path: str) -> int:
     return int(m.group(1))
 
 
+_STAGE_RE = re.compile(r"stage_(\d+)$")
+
+
+def stage_dir(run_dir: str, stage: int) -> str:
+    """One MPMD pipeline stage's run dir inside a pipeline run dir — the
+    dir its supervising launcher ring writes ``attempts.jsonl``/beacons
+    into and its worker writes goodput sidecars into (the stage-side twin
+    of :func:`replica_dir`, owned here for the same import-light
+    reason)."""
+    return os.path.join(run_dir, f"stage_{stage}")
+
+
+def list_stage_dirs(run_dir: str) -> List[str]:
+    out = []
+    for path in glob.glob(os.path.join(run_dir, "stage_*")):
+        if _STAGE_RE.search(path) and os.path.isdir(path):
+            out.append(path)
+    return sorted(out, key=stage_id)
+
+
+def stage_id(stage_dir_path: str) -> int:
+    m = _STAGE_RE.search(stage_dir_path)
+    if m is None:
+        raise ValueError(f"not a stage dir: {stage_dir_path!r}")
+    return int(m.group(1))
+
+
 def serving_journal_path(fleet_dir: str) -> str:
     """The router's durable request journal (append-only JSONL)."""
     return os.path.join(fleet_dir, "journal.jsonl")
@@ -266,6 +296,10 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
     """
     attempts = read_attempts(run_dir)
     sidecars = read_goodput_records(run_dir)
+    if not attempts and not sidecars:
+        stages = list_stage_dirs(run_dir)
+        if stages:
+            return _aggregate_pipeline(stages)
     serving_recs = read_serving_records(run_dir)
     cats = {c: 0.0 for c in _CATEGORIES}
     useful = lost = downtime = hang = 0.0
@@ -337,6 +371,55 @@ def aggregate_run(run_dir: str) -> Dict[str, Any]:
         "attempts": len(per_attempt),
         "serving_attempts": serving_attempts,
         "per_attempt": per_attempt,
+    }
+
+
+def _aggregate_pipeline(stage_dirs: List[str]) -> Dict[str, Any]:
+    """Fold an MPMD pipeline run dir (one ``stage_{k}`` launcher-ring dir
+    per stage, no root-level attempts) into ONE decomposition: every
+    numeric field sums across the per-stage folds, so ``wall_s`` is
+    summed STAGE wall (an S-stage run's wall is ~S x the clock time —
+    every stage-second accounted, the same contract as
+    :func:`aggregate_serving`'s summed replica wall) and
+    ``accounted_frac`` stays 1.0 iff it held per stage. ``per_stage``
+    keeps each stage's own fold (minus its per_attempt detail) so a
+    restart on stage k is attributable to stage k alone."""
+    cats = {c: 0.0 for c in _CATEGORIES}
+    useful = lost = downtime = hang = wall = accounted = 0.0
+    n_attempts = 0
+    serving_attempts = 0
+    per_stage: List[dict] = []
+    for sd in stage_dirs:
+        agg = aggregate_run(sd)
+        wall += _fnum(agg.get("wall_s"))
+        useful += _fnum(agg.get("useful_step_s"))
+        for c in _CATEGORIES:
+            cats[c] += _fnum(agg.get(c))
+        hang += _fnum(agg.get("hang_s"))
+        lost += _fnum(agg.get("lost_s"))
+        downtime += _fnum(agg.get("downtime_s"))
+        accounted += _fnum(agg.get("accounted_s"))
+        n_attempts += int(_fnum(agg.get("attempts")))
+        serving_attempts += int(_fnum(agg.get("serving_attempts")))
+        per_stage.append({"stage": stage_id(sd),
+                          **{k: v for k, v in agg.items()
+                             if k != "per_attempt"}})
+    wall = max(wall, 1e-9)
+    return {
+        "wall_s": wall,
+        "useful_step_s": useful,
+        "goodput": useful / wall,
+        **cats,
+        "hang_s": hang,
+        "lost_s": lost,
+        "downtime_s": downtime,
+        "accounted_s": accounted,
+        "accounted_frac": accounted / wall,
+        "attempts": n_attempts,
+        "serving_attempts": serving_attempts,
+        "stages": len(per_stage),
+        "per_stage": per_stage,
+        "per_attempt": [],
     }
 
 

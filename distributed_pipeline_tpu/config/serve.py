@@ -155,6 +155,22 @@ class ServeSettings(S):
                             "corrupt_swap_checkpoint); also honors the "
                             "DPT_CHAOS_PLAN env like training")
 
+    # -------------------------------------------- disaggregation (ISSUE 16)
+    disagg: int = _(0, "disaggregated prefill/decode serving (mpmd/"
+                       "disagg.py): the --replicas workers become PREFILL-"
+                       "only workers that stream each admitted request's "
+                       "paged-KV pages + first token over a StageLink to a "
+                       "separately supervised DECODE ring; requests still "
+                       "enter through the router. Value = decode ring "
+                       "count (only 1 is supported); 0 = colocated "
+                       "(every replica prefills and decodes)")
+    disagg_role: str = _("", "INTERNAL: 'prefill' or 'decode' — set on the "
+                             "worker argv by the disaggregated fleet parent")
+    disagg_links: str = _("", "INTERNAL: StageLink directory shared by the "
+                              "prefill and decode workers")
+    disagg_peers: int = _(0, "INTERNAL: number of prefill workers whose "
+                             "kv/tok links the decode worker polls")
+
     # ------------------------------------------------ hot-swap (ISSUE 11)
     swap_after_requests: int = _(0, "trigger a zero-downtime checkpoint "
                                     "hot-swap once this many requests "

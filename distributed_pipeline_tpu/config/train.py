@@ -226,6 +226,31 @@ class MeshSettings(S):
               "table is measured first so a tiny budget degrades to "
               "the hand-tuned layout)")
 
+    # --------------------------------------------------- MPMD (ISSUE 16)
+    mpmd: bool = _(
+        False, "MPMD pipeline training (mpmd/): each stage runs as its "
+               "OWN supervised process ring with its own restart budget "
+               "and snapshots (stages are independently preemptible), a "
+               "jax-free host driver broadcasts the --pp_schedule "
+               "microbatch schedule, and activations/grads move over the "
+               "StageLink transport instead of a collective; requires "
+               "--scan_layers true; the in-program mesh axes (dp/pipe/"
+               "...) apply WITHIN each stage, so keep them 1/-1 defaults "
+               "unless each stage really has a sub-mesh")
+    mpmd_stages: int = _(2, "MPMD stage count (process rings); "
+                            "num_layers need not divide it — stages take "
+                            "floor-balanced layer slices")
+    mpmd_link_capacity: int = _(8, "StageLink in-flight frame cap per "
+                                   "direction (backpressure: a sender "
+                                   "blocks past this and books the wait "
+                                   "as link_wait)")
+    mpmd_hang_timeout_s: float = _(0.0, "per-stage beacon watchdog: a "
+                                        "stage whose beacons freeze this "
+                                        "long is SIGKILLed and restarted "
+                                        "by ITS OWN ring (0 = off)")
+    mpmd_max_restarts: int = _(3, "per-stage restart budget (sliding "
+                                  "window, launcher semantics)")
+
 
 class TrainSettings(GeneralSettings, DataSettings, ModelSettings, MeshSettings):
     """Composed settings, flat like the reference's reverse-MRO composition

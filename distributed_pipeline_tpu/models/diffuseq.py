@@ -29,6 +29,24 @@ from .diffusion import DiffusionSchedule
 __all__ = ["DiffuSeqModel", "diffuseq_losses", "timestep_embedding"]
 
 
+def _pin_batch(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin an activation to pure batch sharding (data x fsdp on dim 0, every
+    other dim replicated). The backbone kernels ZeRO-shard their EMBED input
+    dims over fsdp; left to propagation, GSPMD pushes that hidden-dim
+    sharding back onto the residual stream where it collides with the batch
+    sharding and the partitioner falls back to "Involuntary full
+    rematerialization" on every LayerNorm broadcast (dp x fsdp x tp meshes).
+    Pinning the stream keeps activations batch-sharded and turns the weight
+    shards into per-layer all-gathers instead. No-op without a mesh."""
+    from jax.interpreters import pxla
+
+    mesh = pxla.thread_resources.env.physical_mesh
+    if mesh.empty or "data" not in mesh.shape or "fsdp" not in mesh.shape:
+        return x
+    spec = jax.sharding.PartitionSpec(("data", "fsdp"))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
 def timestep_embedding(t: jnp.ndarray, dim: int,
                        max_period: float = 10_000.0) -> jnp.ndarray:
     """Sinusoidal timestep features [B, dim] (f32; tiny op, precision cheap)."""
@@ -138,7 +156,9 @@ class DiffuSeqModel(nn.Module):
         h = self.in_proj(x_t.astype(self.dtype))
         h = h + self.time_mlp(timestep_embedding(t, self.hidden_size))[:, None, :].astype(self.dtype)
         h = h + self.pos_emb[None, :L].astype(self.dtype)
+        h = _pin_batch(h)
         h = self.backbone(h, pad_mask)  # bidirectional, pad-masked
+        h = _pin_batch(h)
         return self.out_proj(h).astype(jnp.float32)
 
 

@@ -1,0 +1,68 @@
+"""MPMD runtime: one stage-to-stage send/recv substrate for pipeline
+training AND disaggregated prefill/decode serving (ISSUE 16).
+
+Layering (strictly jax-free below the line — the driver/launcher side
+runs in supervisor processes that must never initialize a backend):
+
+* ``link.py``       — StageLink transport: FileStageLink (atomic-rename
+  host relay, the transport this image's jax can actually run) and
+  MemStageLink (in-process), one wire format, epoch fencing,
+  backpressure, link_wait accounting;
+* ``protocol.py``   — run-dir layout, per-stage paths/beacons/snapshots,
+  the 1F1B/GPipe schedule generator, host-side goodput;
+* ``driver.py``     — the host pipeline driver: one supervised launcher
+  ring PER STAGE, two-phase step broadcast/collect, epoch-fenced rewind
+  recovery;
+  ----------------------------------------------------------------- jax
+* ``stage_math.py`` — per-stage parameter slices, forward/backward
+  microbatch math, per-slice optimizer (exact vs the single-program
+  trainer), and the in-process pipeline reference runner;
+* ``stage_worker.py`` — the per-stage worker process the driver spawns;
+* ``disagg.py``     — disaggregated serving: PrefillClient + the KV-page
+  wire frames feeding ``DecodeServer.submit_prefilled``.
+
+Imports here are lazy (PEP 562) so ``from ..mpmd import PipelineDriver``
+in a jax-free process pulls in nothing from the jax side.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "FileStageLink", "MemStageLink", "StageLink", "flatten_tree",
+    "unflatten_tree",
+    "HostGoodput", "StagePaths", "StageProtocol", "link_dir",
+    "read_config", "schedule_for", "write_config",
+    "PipelineDriver",
+    "StageMath", "run_pipeline_inprocess",
+    "StageWorker",
+    "PrefillClient", "pack_kv_frame", "serve_disagg_inprocess",
+    "unpack_kv_frame",
+]
+
+_HOMES = {
+    "FileStageLink": "link", "MemStageLink": "link", "StageLink": "link",
+    "flatten_tree": "link", "unflatten_tree": "link",
+    "HostGoodput": "protocol", "StagePaths": "protocol",
+    "StageProtocol": "protocol", "link_dir": "protocol",
+    "read_config": "protocol", "schedule_for": "protocol",
+    "write_config": "protocol",
+    "PipelineDriver": "driver",
+    "StageMath": "stage_math", "run_pipeline_inprocess": "stage_math",
+    "StageWorker": "stage_worker",
+    "PrefillClient": "disagg", "pack_kv_frame": "disagg",
+    "serve_disagg_inprocess": "disagg", "unpack_kv_frame": "disagg",
+}
+
+
+def __getattr__(name: str):
+    home = _HOMES.get(name)
+    if home is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{home}", __name__), name)
+
+
+def __dir__():
+    return sorted(__all__)

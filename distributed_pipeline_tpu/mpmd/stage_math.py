@@ -1,0 +1,526 @@
+"""Per-stage model math for the MPMD pipeline (the jax side).
+
+One :class:`StageMath` owns ONE stage's parameter slice, its jitted
+forward/backward, its own optax.adamw shard, and (stage 0 only) the
+data stream. The decomposition reproduces the in-program 1F1B glue
+(models/schedule_1f1b.py gpt2_1f1b_losses / diffuseq_1f1b_losses)
+EXACTLY, term for term, so a 2-stage MPMD run matches the single-
+program reference loss sequence within the established drift tolerance:
+
+* every stage inits the FULL parameter tree from the trainer's seed
+  derivation (``fold_in(PRNGKey(seed), 0)`` -> ``nn.meta.unbox(
+  wl.init_params(...))``, trainer.py _build_state) and keeps only its
+  slice — no parameter broadcast, bit-identical init across stages;
+* microbatch chunk losses are SUMS scaled by the FULL-batch denominator
+  (``inv_denom``/``inv_tgt`` computed on stage 0, shipped as a 0-d
+  array in the act frames), so chunk-sum == full-batch loss and the
+  accumulated grads equal the reference full-batch gradient at the
+  reference's n_micro=1 scale of 1.0;
+* adamw is elementwise, so per-slice ``opt.update`` on per-slice grads
+  is EXACTLY the full-tree update restricted to the slice. The one
+  cross-stage coupling is gpt2's tied word embedding (lookup on stage
+  0, logit head on the last stage): both hold a copy, their grads sum
+  through the driver (``shared``/``shared_sum``), and identical
+  (grad, moments) on both sides keep the copies bit-identical;
+* middle/first backward recomputes the forward under ``jax.vjp``
+  (remat-style — activations are never stashed across the wire), the
+  last stage runs a fused value_and_grad;
+* diffuseq draws t/noise per (seed, step, microbatch) via fold_in, so
+  a rewind REPLAYS the identical randomness (the reference's single
+  full-batch draw is one rng shape away; the loss-equivalence
+  acceptance runs gpt2, which is rng-free).
+
+Also home to :func:`run_pipeline_inprocess` — the same math over
+MemStageLinks in one process (the dryrun leg and the numerics tests;
+the subprocess worker shares this class, so its numbers carry over).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .link import MemStageLink, flatten_tree, unflatten_tree
+
+__all__ = ["StageMath", "run_pipeline_inprocess", "stage_param_bounds"]
+
+
+def stage_param_bounds(num_layers: int, stage: int, n_stages: int):
+    """Contiguous layer slice [lo, hi) for one stage (balanced split)."""
+    return (stage * num_layers // n_stages,
+            (stage + 1) * num_layers // n_stages)
+
+
+def _chunk(arr, n_mb: int, mb: int):
+    c = arr.shape[0] // n_mb
+    return arr[mb * c:(mb + 1) * c]
+
+
+class StageMath:
+    """One stage's params + compiled step math (see module docstring)."""
+
+    def __init__(self, config: Dict[str, Any], stage: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        import flax.linen as nn
+        import optax
+        from ..models import create_model_from_config
+        from ..models.schedule_1f1b import _stage_fn_for
+
+        self._jax, self._jnp, self._optax = jax, jnp, optax
+        self.config = config
+        self.stage = int(stage)
+        self.n_stages = int(config["n_stages"])
+        self.is_first = self.stage == 0
+        self.is_last = self.stage == self.n_stages - 1
+        model_kwargs = dict(config["model"])
+        self.family = model_kwargs.get("model_family", "gpt2")
+        if not model_kwargs.get("scan_layers"):
+            raise ValueError("MPMD stages slice the stacked layer dim: "
+                             "model must be built with scan_layers=True")
+        wl = create_model_from_config(**model_kwargs)
+        self.wl = wl
+        model = wl.model
+        self.dtype = model.dtype
+        self.seq_len = wl.seq_len
+        self.tied = (self.family == "gpt2" and self.n_stages > 1
+                     and self.stage in (0, self.n_stages - 1))
+        self.shared_keys = ["word_emb"] if self.tied else []
+
+        # --- full init from the trainer's exact seed derivation, then slice
+        seed = int(config.get("seed", 0))
+        init_rng = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+        full = jax.jit(lambda r: nn.meta.unbox(wl.init_params(r)))(init_rng)
+        p = full["params"]
+        L = wl.num_layers
+        lo, hi = stage_param_bounds(L, self.stage, self.n_stages)
+        blocks = jax.tree_util.tree_map(lambda a: a[lo:hi],
+                                        dict(p["backbone"]["blocks"]))
+        params: Dict[str, Any] = {"blocks": blocks}
+        if self.family == "gpt2":
+            if self.is_first:
+                params["word_emb"] = p["word_emb"]["embedding"]
+                params["pos_emb"] = p["pos_emb"]
+            if self.is_last:
+                params["word_emb"] = p["word_emb"]["embedding"]
+                params["ln_f_scale"] = p["backbone"]["ln_f"]["scale"]
+                params["ln_f_bias"] = p["backbone"]["ln_f"]["bias"]
+        else:  # diffuseq
+            if self.is_first:
+                params.update({
+                    "word_emb": p["word_emb"]["embedding"],
+                    "in_w": p["in_proj"]["kernel"],
+                    "in_b": p["in_proj"]["bias"],
+                    "t0_w": p["time_mlp"]["layers_0"]["kernel"],
+                    "t0_b": p["time_mlp"]["layers_0"]["bias"],
+                    "t1_w": p["time_mlp"]["layers_2"]["kernel"],
+                    "t1_b": p["time_mlp"]["layers_2"]["bias"],
+                    "pos_emb": p["pos_emb"]})
+            if self.is_last:
+                params.update({
+                    "ln_f_scale": p["backbone"]["ln_f"]["scale"],
+                    "ln_f_bias": p["backbone"]["ln_f"]["bias"],
+                    "out_w": p["out_proj"]["kernel"],
+                    "out_b": p["out_proj"]["bias"]})
+        self.params = params
+        del full, p
+
+        # --- per-slice adamw: trainer._make_optimizer with the constant-lr
+        # schedule arm (learning_steps == 0, no warmup)
+        self.opt = optax.adamw(float(config.get("lr", 1e-3)),
+                               b1=0.9, b2=0.999, eps=1e-8,
+                               weight_decay=float(
+                                   config.get("weight_decay", 0.0)))
+        self.opt_state = self.opt.init(self.params)
+        self._apply_fn = jax.jit(self._apply_impl)
+
+        self._stage_fn = _stage_fn_for(model, {}, causal=(
+            self.family == "gpt2"), tp=False)
+        self._base_rng = jax.random.PRNGKey(seed)
+        self._build_fns()
+
+        # --- data (stage 0 regenerates batch t deterministically, incl.
+        # across rewind replays, via the loader's O(1) skip_batches resume)
+        self._data_iter = None
+        self._data_pos = -1
+        self._ctx: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------ compiled fns
+    def _apply_impl(self, params, opt_state, grads):
+        updates, new_state = self.opt.update(grads, opt_state, params)
+        return self._optax.apply_updates(params, updates), new_state
+
+    def _build_fns(self) -> None:
+        jax, jnp = self._jax, self._jnp
+        from ..models.pipeline import _layernorm
+        from ..ops.xent import token_cross_entropy
+
+        stage_fn = self._stage_fn
+        dtype = self.dtype
+        L = self.seq_len
+
+        if self.family == "gpt2":
+            if self.is_first:
+                def first_out(p, ids, pad):
+                    h = (p["word_emb"][ids]
+                         + p["pos_emb"][None, :L]).astype(dtype)
+                    return stage_fn(p["blocks"], h, pad)
+
+                self._fwd_first = jax.jit(first_out)
+
+                def first_bwd(p, ids, pad, dh):
+                    _, vjp = jax.vjp(lambda q: first_out(q, ids, pad), p)
+                    return vjp(dh)[0]
+
+                self._bwd_first = jax.jit(first_bwd)
+            if self.is_last:
+                def last_fb(p, h, ids, pad, lm, inv_denom):
+                    def f(q, hh):
+                        h2 = stage_fn(q["blocks"], hh, pad)
+                        h2 = _layernorm(h2, q["ln_f_scale"],
+                                        q["ln_f_bias"]).astype(dtype)
+                        logits = jnp.einsum(
+                            "bld,vd->blv", h2,
+                            q["word_emb"].astype(dtype))[:, :-1]
+                        targets = ids[:, 1:]
+                        nll = token_cross_entropy(logits, targets)
+                        loss_sum = (nll * lm).sum() * inv_denom
+                        hit = (jnp.argmax(logits, axis=-1) == targets)
+                        acc = ((hit.astype(jnp.float32) * lm).sum()
+                               * inv_denom).astype(jnp.float32)
+                        return loss_sum.astype(jnp.float32), acc
+                    (loss, acc), (gp, dh) = jax.value_and_grad(
+                        f, argnums=(0, 1), has_aux=True)(p, h)
+                    return loss, acc, gp, dh
+
+                self._fb_last = jax.jit(last_fb)
+        else:  # diffuseq
+            schedule = self.wl.schedule
+            from ..models.diffuseq import timestep_embedding
+            H = self.wl.hidden_size
+
+            if self.is_first:
+                def first_all(p, ids, tm, pad, t, noise, inv_tgt):
+                    we = p["word_emb"]
+                    x_start = we[ids]
+                    x_noisy = schedule.q_sample(x_start, t, noise)
+                    x_t = jnp.where(tm[..., None] > 0, x_noisy, x_start)
+                    h = (jnp.einsum("ble,eh->blh", x_t.astype(dtype),
+                                    p["in_w"].astype(dtype))
+                         + p["in_b"].astype(dtype))
+                    te = timestep_embedding(t, H)
+                    te = (jax.nn.silu(te @ p["t0_w"] + p["t0_b"])
+                          @ p["t1_w"] + p["t1_b"])
+                    h = h + te[:, None, :].astype(dtype)
+                    h = h + p["pos_emb"][None, :L].astype(dtype)
+                    h = stage_fn(p["blocks"], h, pad)
+                    # the two embedding-only loss terms live here, chunked
+                    # with the full-batch masked-mean denominator
+                    tT = (schedule.mean_flat_tT(x_start) * tm).sum() * inv_tgt
+                    logits = jnp.einsum("...e,ve->...v",
+                                        x_start.astype(dtype),
+                                        we.astype(dtype))
+                    dn = ((token_cross_entropy(logits, ids) * tm).sum()
+                          * inv_tgt)
+                    local = (tT + dn).astype(jnp.float32)
+                    return h, x_start, local
+
+                self._fwd_first = jax.jit(first_all)
+
+                def first_bwd(p, ids, tm, pad, t, noise, inv_tgt,
+                              dh, dxs):
+                    _, vjp = jax.vjp(
+                        lambda q: first_all(q, ids, tm, pad, t, noise,
+                                            inv_tgt), p)
+                    return vjp((dh, dxs, jnp.float32(1.0)))[0]
+
+                self._bwd_first = jax.jit(first_bwd)
+            if self.is_last:
+                def last_fb(p, h, x_start, pad, tm, inv_tgt):
+                    def f(q, hh, xs):
+                        h2 = stage_fn(q["blocks"], hh, pad)
+                        h2 = _layernorm(h2, q["ln_f_scale"],
+                                        q["ln_f_bias"]).astype(dtype)
+                        x0_hat = (jnp.einsum("blh,he->ble", h2,
+                                             q["out_w"].astype(dtype))
+                                  + q["out_b"].astype(dtype)
+                                  ).astype(jnp.float32)
+                        per = jnp.mean((x0_hat - xs) ** 2, axis=-1)
+                        return ((per * tm).sum() * inv_tgt
+                                ).astype(jnp.float32)
+                    loss, (gp, dh, dxs) = jax.value_and_grad(
+                        f, argnums=(0, 1, 2))(p, h, x_start)
+                    return loss, gp, dh, dxs
+
+                self._fb_last = jax.jit(last_fb)
+
+        if not self.is_first and not self.is_last:
+            def mid_out(p, h, pad):
+                return stage_fn(p["blocks"], h, pad)
+
+            self._fwd_mid = jax.jit(mid_out)
+
+            def mid_bwd(p, h, pad, dh):
+                _, vjp = jax.vjp(lambda q, hh: mid_out(q, hh, pad), p, h)
+                return vjp(dh)
+
+            self._bwd_mid = jax.jit(mid_bwd)
+
+    # ----------------------------------------------------------------- data
+    def batch_for_step(self, step: int) -> Dict[str, np.ndarray]:
+        """Batch consumed by optimizer step ``step`` (1-indexed): batch
+        ``step - 1`` of the deterministic stream. Rebuilds the iterator
+        with ``skip_batches`` on any non-sequential ask (restart, rewind
+        replay) — exact data-order resume, run/train.py semantics."""
+        want = step - 1
+        if self._data_iter is None or self._data_pos != want:
+            from ..data import load_data_from_args
+            kw = dict(self.config.get("data", {}))
+            self._data_iter = load_data_from_args(
+                "train", batch_size=int(self.config["batch_size"]),
+                skip_batches=want, **kw)
+            self._data_pos = want
+        batch = next(self._data_iter)
+        self._data_pos += 1
+        return batch
+
+    # ------------------------------------------------------------- step state
+    def start_step(self, step: int, n_mb: int) -> None:
+        ctx: Dict[str, Any] = {"step": step, "n_mb": n_mb, "stash": {},
+                               "grads": None, "loss": 0.0, "acc": 0.0,
+                               "grad_out": {}}
+        if self.is_first:
+            batch = self.batch_for_step(step)
+            ids = batch["input_ids"]
+            pad = batch["pad_mask"]
+            if self.family == "gpt2":
+                lm = (batch["input_mask"] * pad)[:, 1:].astype(np.float32)
+                ctx["scalar"] = np.float32(1.0 / max(float(lm.sum()), 1.0))
+                ctx["batch"] = {"ids": ids, "pad": pad, "lm": lm}
+            else:
+                tm = batch["input_mask"].astype(np.float32)
+                ctx["scalar"] = np.float32(1.0 / max(float(tm.sum()), 1.0))
+                ctx["batch"] = {"ids": ids, "pad": pad, "tm": tm}
+                jax = self._jax
+                step_rng = jax.random.fold_in(
+                    jax.random.fold_in(self._base_rng, step), 7)
+                ctx["step_rng"] = step_rng
+        self._ctx = ctx
+
+    def _accum(self, gp) -> None:
+        jax = self._jax
+        if self._ctx["grads"] is None:
+            self._ctx["grads"] = gp
+        else:
+            self._ctx["grads"] = jax.tree_util.tree_map(
+                lambda a, b: a + b, self._ctx["grads"], gp)
+
+    # ------------------------------------------------------------- microbatch
+    def forward_mb(self, mb: int,
+                   inbound: Optional[Dict[str, np.ndarray]]
+                   ) -> Optional[Dict[str, np.ndarray]]:
+        """Run this stage's F op for microbatch ``mb``. Returns the act
+        frame for the next stage, or None on the last stage (whose F is
+        the fused fwd+bwd: the grad frame is stashed for its B op)."""
+        jnp = self._jnp
+        ctx = self._ctx
+        n_mb = ctx["n_mb"]
+        if self.is_first:
+            b = ctx["batch"]
+            ids = _chunk(b["ids"], n_mb, mb)
+            pad = _chunk(b["pad"], n_mb, mb)
+            sc = ctx["scalar"]
+            if self.family == "gpt2":
+                lm = _chunk(b["lm"], n_mb, mb)
+                h = self._fwd_first(self.params, ids, pad)
+                ctx["stash"][mb] = (ids, pad)
+                out = {"h": np.asarray(h), "ids": ids, "pad": pad,
+                       "lm": lm, "sc": sc}
+            else:
+                jax = self._jax
+                tm = _chunk(b["tm"], n_mb, mb)
+                mb_rng = jax.random.fold_in(ctx["step_rng"], mb)
+                rng_t, rng_noise = jax.random.split(mb_rng)
+                t = self.wl.schedule.sample_t(rng_t, ids.shape[0])
+                emb_dim = self.params["word_emb"].shape[1]
+                noise = jax.random.normal(
+                    rng_noise, (ids.shape[0], ids.shape[1], emb_dim),
+                    jnp.float32)
+                h, x_start, local = self._fwd_first(
+                    self.params, ids, tm, pad, t, noise, jnp.float32(sc))
+                ctx["stash"][mb] = (ids, tm, pad, t, noise)
+                ctx["loss"] += float(local)
+                out = {"h": np.asarray(h), "x_start": np.asarray(x_start),
+                       "pad": pad, "tm": tm, "sc": sc}
+            if self.is_last:
+                raise AssertionError("n_stages == 1 is not MPMD")
+            return out
+        assert inbound is not None, "non-first stage F needs an act frame"
+        h = inbound["h"]
+        pad = inbound["pad"]
+        sc = jnp.float32(inbound["sc"])
+        if not self.is_last:
+            h_out = self._fwd_mid(self.params, h, pad)
+            ctx["stash"][mb] = (h, pad)
+            out = dict(inbound)
+            out["h"] = np.asarray(h_out)
+            return out
+        # last stage: fused forward+backward at its F slot (1F1B's last
+        # stage does B immediately; the grad frame waits for the B op)
+        if self.family == "gpt2":
+            loss, acc, gp, dh = self._fb_last(
+                self.params, h, inbound["ids"], pad, inbound["lm"], sc)
+            ctx["acc"] += float(acc)
+            ctx["grad_out"][mb] = {"dh": np.asarray(dh)}
+        else:
+            loss, gp, dh, dxs = self._fb_last(
+                self.params, h, inbound["x_start"], pad, inbound["tm"], sc)
+            ctx["grad_out"][mb] = {"dh": np.asarray(dh),
+                                   "dxs": np.asarray(dxs)}
+        ctx["loss"] += float(loss)
+        self._accum(gp)
+        return None
+
+    def backward_mb(self, mb: int,
+                    inbound: Optional[Dict[str, np.ndarray]]
+                    ) -> Optional[Dict[str, np.ndarray]]:
+        """Run this stage's B op. Returns the grad frame for the previous
+        stage, or None on the first stage (end of the chain)."""
+        ctx = self._ctx
+        if self.is_last:
+            return ctx["grad_out"].pop(mb)
+        assert inbound is not None, "non-last stage B needs a grad frame"
+        dh = inbound["dh"]
+        if self.is_first:
+            stash = ctx["stash"].pop(mb)
+            if self.family == "gpt2":
+                ids, pad = stash
+                gp = self._bwd_first(self.params, ids, pad, dh)
+            else:
+                jnp = self._jnp
+                ids, tm, pad, t, noise = stash
+                gp = self._bwd_first(self.params, ids, tm, pad, t, noise,
+                                     jnp.float32(ctx["scalar"]),
+                                     dh, inbound["dxs"])
+            self._accum(gp)
+            return None
+        h, pad = ctx["stash"].pop(mb)
+        gp, dh_in = self._bwd_mid(self.params, h, pad, dh)
+        self._accum(gp)
+        out = dict(inbound)
+        out["dh"] = np.asarray(dh_in)
+        return out
+
+    # ----------------------------------------------------------------- apply
+    def shared_grads(self) -> Optional[Dict[str, np.ndarray]]:
+        """This stage's partial grads for driver-summed shared params
+        (gpt2's tied word embedding), or None when it shares nothing."""
+        if not self.shared_keys:
+            return None
+        return {k: np.asarray(self._ctx["grads"][k])
+                for k in self.shared_keys}
+
+    def apply(self, shared_sum: Optional[Dict[str, np.ndarray]] = None
+              ) -> Dict[str, float]:
+        """Fold the driver-summed shared grads in, run adamw, return this
+        stage's done payload (loss partial + metric partials)."""
+        grads = self._ctx["grads"]
+        if shared_sum:
+            grads = dict(grads)
+            for k, v in shared_sum.items():
+                grads[k] = self._jnp.asarray(v)
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads)
+        out = {"loss_partial": float(self._ctx["loss"])}
+        if self.family == "gpt2" and self.is_last:
+            out["acc"] = float(self._ctx["acc"])
+        self._ctx = {}
+        return out
+
+    # -------------------------------------------------------------- snapshot
+    def export_flat(self) -> Dict[str, np.ndarray]:
+        jax = self._jax
+        flat = {f"param/{k}": v
+                for k, v in flatten_tree(self.params).items()}
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(self.opt_state)):
+            flat[f"opt/{i:05d}"] = np.asarray(leaf)
+        return flat
+
+    def load_flat(self, flat: Dict[str, np.ndarray]) -> None:
+        jax, jnp = self._jax, self._jnp
+        ptree = unflatten_tree({k[len("param/"):]: v
+                                for k, v in flat.items()
+                                if k.startswith("param/")})
+        self.params = jax.tree_util.tree_map(
+            lambda cur, new: jnp.asarray(new).astype(cur.dtype),
+            self.params, ptree)
+        opt_leaves = [flat[k] for k in sorted(k for k in flat
+                                              if k.startswith("opt/"))]
+        treedef = jax.tree_util.tree_structure(self.opt_state)
+        cur_leaves = jax.tree_util.tree_leaves(self.opt_state)
+        self.opt_state = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(n).astype(c.dtype)
+                      for c, n in zip(cur_leaves, opt_leaves)])
+
+
+def run_pipeline_inprocess(config: Dict[str, Any], n_steps: int,
+                           *, maths: Optional[List[StageMath]] = None
+                           ) -> Dict[str, Any]:
+    """All stages in one process over MemStageLinks — the device-transfer
+    seam's execution shape and the numerics reference for the subprocess
+    runtime (same StageMath, same frames, GPipe-ordered: schedule order
+    never changes the math). Powers the dryrun MPMD leg and the
+    loss-equivalence tests. Pass ``maths`` to continue training existing
+    stages (e.g. across a simulated rewind)."""
+    S = int(config["n_stages"])
+    M = int(config.get("n_microbatches", 1))
+    if maths is None:
+        maths = [StageMath(config, s) for s in range(S)]
+    acts = [MemStageLink(capacity=M + 2) for _ in range(S - 1)]
+    grads = [MemStageLink(capacity=M + 2) for _ in range(S - 1)]
+    start = getattr(maths[0], "_done_steps", 0)
+    losses: List[float] = []
+    metrics: List[Dict[str, float]] = []
+    for step in range(start + 1, start + n_steps + 1):
+        for m in maths:
+            m.start_step(step, M)
+        for mb in range(M):
+            for s in range(S):
+                inb = None
+                if s > 0:
+                    frame = acts[s - 1].recv()
+                    assert frame is not None
+                    inb = frame[0]
+                out = maths[s].forward_mb(mb, inb)
+                if s < S - 1:
+                    acts[s].send(out, {"step": step, "mb": mb})
+        for mb in range(M):
+            for s in range(S - 1, -1, -1):
+                inb = None
+                if s < S - 1:
+                    frame = grads[s].recv()
+                    assert frame is not None
+                    inb = frame[0]
+                out = maths[s].backward_mb(mb, inb)
+                if s > 0:
+                    grads[s - 1].send(out, {"step": step, "mb": mb})
+        shared_sum: Optional[Dict[str, np.ndarray]] = None
+        for m in maths:
+            part = m.shared_grads()
+            if part is not None:
+                shared_sum = (part if shared_sum is None else
+                              {k: shared_sum[k] + part[k] for k in part})
+        dones = [m.apply(shared_sum if m.shared_keys else None)
+                 for m in maths]
+        loss = sum(d["loss_partial"] for d in dones)
+        losses.append(loss)
+        step_metrics = {"loss": loss}
+        for d in dones:
+            for k, v in d.items():
+                if k != "loss_partial":
+                    step_metrics[k] = v
+        metrics.append(step_metrics)
+    for m in maths:
+        m._done_steps = start + n_steps
+    return {"losses": losses, "metrics": metrics, "maths": maths}

@@ -357,6 +357,26 @@ def collect_sources(d: str) -> List[Tuple[int, str, List[dict]]]:
             events.extend(_ledger_events(rd))
             sources.append((10 + rid, f"replica_{rid}", events))
         return sources
+    stage_dirs = goodput.list_stage_dirs(d)
+    if stage_dirs:
+        # MPMD pipeline run (ISSUE 16): pid 1 = the jax-free host driver
+        # (its own shard lives in the run-dir root), pid 10+k per stage —
+        # the stage worker's shard, its supervising ring's attempt spans,
+        # and its beacon (the replica pattern). Cross-process microbatch
+        # stitching rides the trace ids the links carry in frame meta.
+        driver_events = [ev for _, events in _shard_events(d)
+                         for ev in events]
+        sources.append((1, "driver", driver_events))
+        for sd in stage_dirs:
+            sid = goodput.stage_id(sd)
+            shards = _shard_events(sd)
+            events = [ev for _, shard in shards for ev in shard]
+            if not any(label.startswith("launcher")
+                       for label, _ in shards):
+                events.extend(_attempt_events(sd))
+            events.extend(_beacon_events(sd).values())
+            sources.append((10 + sid, f"stage_{sid}", events))
+        return sources
     rank_shards: Dict[int, List[dict]] = {}
     launcher_events: List[dict] = []
     have_launcher_shard = False
@@ -493,8 +513,8 @@ def _prom_run(p: _Prom, run_dir: str, now: float,
               help_="useful-step share of accounted wall time")
         p.add("dpt_accounted_frac", agg["accounted_frac"], labels)
         for cat in ("useful_step_s", "startup_s", "setup_s", "restore_s",
-                    "compile_s", "save_s", "data_stall_s", "recompute_s",
-                    "hang_s", "lost_s", "downtime_s"):
+                    "compile_s", "save_s", "data_stall_s", "link_wait_s",
+                    "recompute_s", "hang_s", "lost_s", "downtime_s"):
             p.add("dpt_goodput_seconds", agg[cat],
                   {**(labels or {}), "category": cat[:-2]},
                   help_="goodput ledger decomposition (seconds)")

@@ -70,6 +70,15 @@ def request_trace_id(req_id: int) -> str:
     return f"req{int(req_id):08d}"
 
 
+def microbatch_trace_id(step: int, mb: int) -> str:
+    """THE cross-process trace identity for one pipeline microbatch —
+    the MPMD runtime's counterpart of :func:`request_trace_id`: every
+    stage's fwd/bwd spans and every link send/recv frame for microbatch
+    ``mb`` of step ``step`` carry this id, so one microbatch stitches
+    into one timeline across stage processes in the Perfetto export."""
+    return f"s{int(step):06d}.mb{int(mb):04d}"
+
+
 class Stopwatch:
     """Monotonic interval timer — the sanctioned way to book wall time
     into a metric OUTSIDE utils/perf.py and obs/ (graftlint GL009 flags

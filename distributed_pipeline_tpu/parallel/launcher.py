@@ -523,6 +523,7 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
     start_step = prev_max_step
     beacon_goodput = None
     serving_snap = None
+    stage = None
     resume_overhead = None
     recompiles = steady_recompiles = None
     if run_dir and os.path.isdir(run_dir):
@@ -559,6 +560,10 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
             # falls back to it when no clean-exit sidecar exists)
             snap = b0.get("serving")
             serving_snap = snap if isinstance(snap, dict) else None
+            # MPMD stage workers stamp their stage id into every beacon:
+            # carried into the attempt record so per-stage rings'
+            # attempts.jsonl rows are attributable after the run
+            stage = b0.get("stage")
             recompiles = b0.get("recompile_count")
             steady_recompiles = b0.get("steady_recompile_count")
             if isinstance(beacon_goodput, dict):
@@ -586,6 +591,8 @@ def _harvest_attempt(run_dir_file: str, attempt: int, rc: int,
     }
     if serving_snap is not None:
         record["serving"] = serving_snap
+    if stage is not None:
+        record["stage"] = stage
     if nprocs is not None:
         # The attempt's actual topology (elastic runs shrink/grow between
         # attempts): what aggregate/debug tooling needs to attribute a

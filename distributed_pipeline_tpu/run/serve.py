@@ -50,8 +50,11 @@ def create_parser() -> argparse.ArgumentParser:
 # (the fleet appends --fleet_worker_dir/--replica_id per replica). One
 # owner, jax-free, so the argv plumbing is unit-testable: anything added
 # to ServeSettings — e.g. cost_ledger — reaches the replica workers.
+# The disagg knobs are parent-only too: the parent appends explicit
+# --disagg_role/--disagg_links/--disagg_peers per worker tier.
 _PARENT_ONLY = {"replicas", "fleet_dir", "fleet_worker_dir",
-                "replica_id", "out", "prompt_file"}
+                "replica_id", "out", "prompt_file",
+                "disagg", "disagg_role", "disagg_links", "disagg_peers"}
 
 
 def _worker_argv(settings: ServeSettings) -> list:
@@ -486,6 +489,264 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     return summary
 
 
+# ============================================== disaggregated fleet workers
+
+def _disagg_prefill_main(settings: ServeSettings) -> dict:
+    """One PREFILL worker of a disaggregated fleet (ISSUE 16): router
+    requests come through the normal replica inbox, but instead of
+    decoding locally the worker runs ONLY the prompt forward and streams
+    the request — paged-KV pages + first token — over its kv StageLink
+    to the decode ring, then relays the decode ring's token replies back
+    to the router outbox. TTFT is stamped HERE: the first token exists
+    the moment prefill completes."""
+    import numpy as np
+
+    from ..mpmd.disagg import PrefillClient, pack_kv_frame
+    from ..mpmd.link import FileStageLink
+    from ..parallel import make_mesh
+    from ..serving.fleet import ReplicaPaths, WorkerProtocol
+    from .sample import load_run
+
+    rid = settings.replica_id
+    paths = ReplicaPaths.at(settings.fleet_worker_dir, rid)
+    proto = WorkerProtocol(paths, rid,
+                           trace_armed=True if settings.trace else None)
+    proto.startup()
+
+    mesh = make_mesh()
+    wl, params, _targs, step, _which = load_run(
+        settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
+    max_len = settings.max_len or wl.seq_len
+    max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
+    pre = PrefillClient(
+        wl, params, page_size=settings.page_size,
+        max_prompt_len=max_prompt_len, max_len=max_len,
+        temperature=settings.temperature, top_k=settings.top_k,
+        top_p=settings.top_p, seed=settings.seed, mesh=mesh)
+    kv_link = FileStageLink(
+        os.path.join(settings.disagg_links, f"kv_{rid}"),
+        capacity=8, tracer=proto.tracer)
+    tok_link = FileStageLink(
+        os.path.join(settings.disagg_links, f"tok_{rid}"),
+        capacity=64, tracer=proto.tracer)
+    pre.warmup()  # compile before ready: first routed TTFT is service time
+
+    tick = 0
+    prefills = 0
+    completed = 0
+    outbound = None  # a packed frame the kv link refused (backpressure)
+    in_flight = {}   # router req id -> inbox payload
+    proto.write_beacon(tick)
+    proto.announce_ready(step)
+    print(f"[disagg-prefill {rid}] ready at step {step} "
+          f"(attempt {proto.attempt})", file=sys.stderr, flush=True)
+
+    while not proto.stop_requested():
+        moved = False
+        if outbound is not None:
+            arrays, meta, payload = outbound
+            if kv_link.send(arrays, meta, timeout_s=0.2,
+                            interrupt=proto.stop_requested):
+                in_flight[int(payload["id"])] = payload
+                proto.consume(int(payload["id"]))
+                outbound = None
+                moved = True
+        if outbound is None:
+            for payload in proto.poll_inbox():
+                if int(payload.get("id", -1)) in in_flight:
+                    continue
+                prompt = np.asarray(payload["prompt"], np.int32)
+                try:
+                    out = pre.prefill(prompt)
+                except ValueError as e:
+                    proto.write_result({"id": int(payload["id"]),
+                                        "tokens": [], "ttft_s": None,
+                                        "error": str(e)})
+                    proto.consume(int(payload["id"]))
+                    continue
+                now = time.time()
+                ttft = max(0.0, now - float(payload.get("submit_t", now)))
+                arrays, meta = pack_kv_frame(
+                    int(payload["id"]), prompt,
+                    int(payload["max_new_tokens"]), out, src=rid,
+                    submit_t=float(payload.get("submit_t", now)),
+                    ttft_s=ttft, trace=payload.get("trace"))
+                prefills += 1
+                moved = True
+                if kv_link.send(arrays, meta, timeout_s=0.2,
+                                interrupt=proto.stop_requested):
+                    in_flight[int(payload["id"])] = payload
+                    proto.consume(int(payload["id"]))
+                else:
+                    outbound = (arrays, meta, payload)
+                    break  # keep inbox order: ship this one first
+        got = tok_link.recv(timeout_s=0.0)
+        if got is not None:
+            _, meta = got
+            payload = in_flight.pop(int(meta["id"]), None)
+            if payload is not None:
+                proto.write_result({
+                    "id": int(meta["id"]),
+                    "tokens": [int(t) for t in meta.get("tokens", [])],
+                    "ttft_s": meta.get("ttft_s"), "params_step": step,
+                    "replays": int(payload.get("replays", 0))})
+                completed += 1
+            moved = True
+        tick += 1
+        proto.write_beacon(tick)
+        if not moved:
+            time.sleep(0.005)
+    proto.tracer.close()
+    summary = {"ticks": tick, "prefills": prefills, "completed": completed,
+               "prompt_tokens": pre.prompt_tokens, "params_step": step,
+               "link_wait_s": round(kv_link.take_wait_s(), 6)}
+    proto.write_sidecar(summary)
+    print(f"[disagg-prefill {rid}] stopping: {json.dumps(summary)}",
+          file=sys.stderr, flush=True)
+    return summary
+
+
+def _disagg_decode_main(settings: ServeSettings) -> dict:
+    """THE decode worker of a disaggregated fleet: polls every prefill
+    worker's kv StageLink, admits transferred requests through
+    ``DecodeServer.submit_prefilled`` (a ``None`` admission leaves the
+    frame on the link — the link IS the backpressure), runs the decode
+    loop, and answers each completed request on the owning prefill
+    worker's tok link. Runs under its own supervised ring in
+    ``<fleet_dir>/decode`` with the same beacon/sidecar discipline as a
+    replica — a restart recovers the SERVICE; requests whose transferred
+    KV died with the attempt are not replayed (the router only replays
+    on prefill-replica death) and fall to the fleet deadline."""
+    import numpy as np
+
+    from ..mpmd.disagg import unpack_kv_frame
+    from ..mpmd.link import FileStageLink
+    from ..parallel import make_mesh
+    from ..serving import DecodeServer
+    from ..serving.fleet import ReplicaPaths, WorkerProtocol
+    from .sample import load_run
+
+    rid = settings.replica_id
+    paths = ReplicaPaths.at(settings.fleet_worker_dir, rid)
+    proto = WorkerProtocol(paths, rid,
+                           trace_armed=True if settings.trace else None)
+    proto.startup()
+
+    mesh = make_mesh()
+    wl, params, _targs, step, _which = load_run(
+        settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
+    max_len = settings.max_len or wl.seq_len
+    max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
+    server = DecodeServer(
+        wl, params, decode_slots=settings.decode_slots,
+        page_size=settings.page_size, max_pages=settings.max_pages,
+        max_prompt_len=max_prompt_len, max_len=max_len,
+        prefill_batch=settings.prefill_batch,
+        decode_span=settings.decode_span,
+        dispatch_lag=settings.dispatch_lag,
+        temperature=settings.temperature, top_k=settings.top_k,
+        top_p=settings.top_p, seed=settings.seed,
+        eos_id=settings.eos_id if settings.eos_id >= 0 else None,
+        mesh=mesh, sanitize=settings.sanitize)
+    n_peers = max(1, settings.disagg_peers)
+    kv_links = [FileStageLink(
+        os.path.join(settings.disagg_links, f"kv_{i}"),
+        capacity=8, tracer=proto.tracer) for i in range(n_peers)]
+    tok_links = [FileStageLink(
+        os.path.join(settings.disagg_links, f"tok_{i}"),
+        capacity=64, tracer=proto.tracer) for i in range(n_peers)]
+
+    # decode-executable warmup before ready (the colocated worker's
+    # rationale): budget 2 so the decode step compiles here, not on the
+    # first transferred request
+    warm = server.submit(np.full((2,), 4, np.int32), max_new_tokens=2)
+    server.drain()
+    del warm
+    server.reset_stats()
+
+    tick = 0
+    admitted = 0
+    completed = 0
+    tokens_out = 0
+    held = None       # (link index, unpacked frame) awaiting capacity
+    in_flight = {}    # req key -> (server Request, frame meta)
+    next_link = 0
+    proto.write_beacon(tick)
+    proto.announce_ready(step)
+    print(f"[disagg-decode {rid}] ready at step {step} "
+          f"(attempt {proto.attempt}, {n_peers} prefill peers)",
+          file=sys.stderr, flush=True)
+
+    def _reply_done() -> None:
+        nonlocal completed, tokens_out
+        for key, (req, meta) in list(in_flight.items()):
+            if not req.finished:
+                continue
+            tok_links[int(meta["src"])].send({}, {
+                "op": "tok", "id": int(meta["id"]),
+                "tokens": [int(t) for t in req.tokens],
+                "ttft_s": meta.get("ttft_s")},
+                timeout_s=5.0, interrupt=proto.stop_requested)
+            completed += 1
+            tokens_out += len(req.tokens)
+            del in_flight[key]
+
+    try:
+        while not proto.stop_requested():
+            moved = False
+            if held is None:
+                for k in range(n_peers):
+                    i = (next_link + k) % n_peers
+                    got = kv_links[i].recv(timeout_s=0.0)
+                    if got is not None:
+                        held = unpack_kv_frame(*got)
+                        next_link = (i + 1) % n_peers
+                        moved = True
+                        break
+            if held is not None:
+                try:
+                    req = server.submit_prefilled(
+                        held["prompt"], int(held["max_new_tokens"]),
+                        first_token=int(held["first_token"]),
+                        kv_pages=held["kv"])
+                except ValueError as e:
+                    tok_links[int(held["src"])].send({}, {
+                        "op": "tok", "id": int(held["id"]), "tokens": [],
+                        "ttft_s": None, "error": str(e)},
+                        timeout_s=5.0, interrupt=proto.stop_requested)
+                    held = None
+                    moved = True
+                else:
+                    if req is not None:  # else: full — retry after a step
+                        in_flight[(int(held["src"]), int(held["id"]))] = (
+                            req, held)
+                        admitted += 1
+                        held = None
+                        moved = True
+            if server.busy:
+                server.step()
+                moved = True
+            _reply_done()
+            tick += 1
+            proto.write_beacon(tick)
+            if not moved:
+                time.sleep(0.005)
+    finally:
+        server.stop_sanitizer()
+    while server.busy:  # graceful stop: drain in-flight decodes
+        server.step()
+        tick += 1
+        proto.write_beacon(tick)
+    _reply_done()
+    proto.tracer.close()
+    summary = {"ticks": tick, "admitted": admitted, "completed": completed,
+               "tokens": tokens_out, "params_step": step}
+    proto.write_sidecar(summary)
+    print(f"[disagg-decode {rid}] stopping: {json.dumps(summary)}",
+          file=sys.stderr, flush=True)
+    return summary
+
+
 # ========================================================= fleet supervisor
 
 def _fleet_main(settings: ServeSettings) -> dict:
@@ -529,6 +790,28 @@ def _fleet_main(settings: ServeSettings) -> dict:
 
     argv = _worker_argv(settings)
 
+    # Disaggregation (ISSUE 16): the --replicas workers become PREFILL
+    # tiers and a second 1-ring ServingFleet under <fleet_dir>/decode
+    # runs the decode tier; both tiers get explicit role flags plus the
+    # shared StageLink directory appended to the common worker argv.
+    decode_fleet = None
+    argv_prefill = argv
+    if settings.disagg > 0:
+        if settings.disagg != 1:
+            raise SystemExit("--disagg supports exactly one decode ring "
+                             f"(got {settings.disagg})")
+        if settings.swap_after_requests > 0:
+            # a hot-swap would drain the prefill tier while the decode
+            # tier still holds transferred KV computed by OLD params —
+            # token streams would silently mix checkpoints
+            raise SystemExit("--disagg and --swap_after_requests are "
+                             "mutually exclusive")
+        links_dir = os.path.join(fleet_dir, "links")
+        os.makedirs(links_dir, exist_ok=True)
+        disagg_argv = ["--disagg_links", links_dir,
+                       "--disagg_peers", str(settings.replicas)]
+        argv_prefill = argv + ["--disagg_role", "prefill"] + disagg_argv
+
     # Replica backend: 'auto' = the parent's own platform selection
     # (JAX_PLATFORMS in this jax-free parent's env — "cpu" under every
     # test/dev/bench ring, unset on a real TPU host so replicas get the
@@ -539,13 +822,24 @@ def _fleet_main(settings: ServeSettings) -> dict:
         platform = os.environ.get("JAX_PLATFORMS", "")
     fleet = ServingFleet(
         fleet_dir, settings.replicas,
-        "distributed_pipeline_tpu.run.serve", argv,
+        "distributed_pipeline_tpu.run.serve", argv_prefill,
         devices_per_proc=1,
         hang_timeout_s=settings.hang_timeout_s,
         max_restarts=settings.fleet_max_restarts,
         restart_backoff_s=settings.fleet_backoff_s,
         replica_platform=platform)
     fleet.start()
+    if settings.disagg > 0:
+        decode_fleet = ServingFleet(
+            os.path.join(fleet_dir, "decode"), 1,
+            "distributed_pipeline_tpu.run.serve",
+            argv + ["--disagg_role", "decode"] + disagg_argv,
+            devices_per_proc=1,
+            hang_timeout_s=settings.hang_timeout_s,
+            max_restarts=settings.fleet_max_restarts,
+            restart_backoff_s=settings.fleet_backoff_s,
+            replica_platform=platform)
+        decode_fleet.start()
     router = Router(fleet.clients(), goodput.serving_journal_path(fleet_dir),
                     stale_beacon_s=settings.stale_beacon_s)
 
@@ -612,6 +906,7 @@ def _fleet_main(settings: ServeSettings) -> dict:
             time.sleep(0.01)
     finally:
         rcs = fleet.stop()
+        decode_rcs = decode_fleet.stop() if decode_fleet else None
     wall_s = time.perf_counter() - t0
 
     records = sorted(router.records.values(), key=lambda r: r.id)
@@ -650,6 +945,13 @@ def _fleet_main(settings: ServeSettings) -> dict:
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in agg.items() if k != "per_replica"},
     }
+    if decode_fleet is not None:
+        dagg = goodput.aggregate_serving(os.path.join(fleet_dir, "decode"))
+        result["disagg"] = settings.disagg
+        result["decode_rcs"] = decode_rcs
+        result["decode_goodput"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in dagg.items() if k != "per_replica"}
     print(json.dumps(result))
     return result
 
@@ -657,6 +959,10 @@ def _fleet_main(settings: ServeSettings) -> dict:
 def main(ns: argparse.Namespace) -> dict:
     settings = ServeSettings.from_argparse(ns)
     if settings.fleet_worker_dir:
+        if settings.disagg_role == "prefill":
+            return _disagg_prefill_main(settings)
+        if settings.disagg_role == "decode":
+            return _disagg_decode_main(settings)
         return _fleet_worker_main(settings)
     if settings.replicas > 0:
         return _fleet_main(settings)

@@ -28,7 +28,8 @@ from ..chaos import goodput
 from ..obs import export as export_lib
 from ..obs import ledger as ledger_lib
 
-__all__ = ["fleet_status", "main", "render", "run_status", "status"]
+__all__ = ["fleet_status", "main", "pipeline_status", "render",
+           "run_status", "status"]
 
 
 def _age(now: float, t: Any) -> Optional[float]:
@@ -99,6 +100,81 @@ def run_status(run_dir: str, now: Optional[float] = None,
     return snap
 
 
+# ------------------------------------------------------------ MPMD pipeline
+
+def pipeline_status(run_dir: str, now: Optional[float] = None,
+                    stale_s: float = 10.0) -> dict:
+    """MPMD pipeline snapshot (ISSUE 16): one row per STAGE — each stage
+    is its own supervised launcher ring, so health is per-stage: ready
+    announce (attempt + params_step), beacon liveness, per-stage goodput
+    including the link_wait share, and the ring's attempt count. The
+    bottom line folds the whole pipeline with the per-stage goodput
+    aggregator (chaos/goodput.py)."""
+    now = time.time() if now is None else now
+    try:
+        with open(os.path.join(run_dir, "mpmd_config.json")) as f:
+            cfg = json.load(f)
+    except (OSError, ValueError):
+        cfg = {}
+    rows = []
+    for sd in goodput.list_stage_dirs(run_dir):
+        sid = goodput.stage_id(sd)
+        try:
+            with open(os.path.join(sd, "ready.json")) as f:
+                ready = json.load(f)
+        except (OSError, ValueError):
+            ready = None
+        b = goodput.read_beacons(sd).get(0) or {}
+        age = _age(now, b.get("t"))
+        if ready is None and not b:
+            state = "init"
+        elif age is not None and age > stale_s:
+            state = "stale"
+        elif ready is None:
+            state = "starting"
+        else:
+            state = "advancing"
+        gp = b.get("goodput") if isinstance(b.get("goodput"), dict) else {}
+        # stage beacons carry the raw HostGoodput decomposition; the
+        # ratio is useful step time over this attempt's wall
+        ratio = None
+        try:
+            wall = float(gp.get("wall_s") or 0.0)
+            if wall > 0:
+                ratio = round(float(gp.get("useful_step_s", 0.0)) / wall, 4)
+        except (TypeError, ValueError):
+            pass
+        rows.append({
+            "stage": sid,
+            "state": state,
+            "attempt": b.get("attempt", ready.get("attempt")
+                             if ready else None),
+            "params_step": ready.get("params_step") if ready else None,
+            "step": b.get("step"),
+            "beacon_age_s": round(age, 1) if age is not None else None,
+            "link_wait_s": gp.get("link_wait_s"),
+            "goodput": ratio,
+            "steady_recompiles": b.get("steady_recompile_count"),
+            "attempts": len(goodput.read_attempts(sd)),
+        })
+    agg = goodput.aggregate_run(run_dir) if rows else None
+    return {
+        "kind": "pipeline",
+        "dir": os.path.abspath(run_dir),
+        "n_stages": cfg.get("n_stages", len(rows)),
+        "schedule": cfg.get("schedule"),
+        "step": min((r["params_step"] for r in rows
+                     if isinstance(r.get("params_step"), int)),
+                    default=None),
+        "stages": rows,
+        "goodput": (round(agg["goodput"], 4) if agg else None),
+        "link_wait_s": (round(agg.get("link_wait_s", 0.0), 4) if agg
+                        else None),
+        "accounted_frac": (round(agg["accounted_frac"], 4) if agg
+                           else None),
+    }
+
+
 # ------------------------------------------------------------ serving fleet
 
 def fleet_status(fleet_dir: str, now: Optional[float] = None,
@@ -165,8 +241,12 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
 
 def status(d: str, now: Optional[float] = None,
            stale_s: float = 10.0) -> dict:
-    return (fleet_status(d, now, stale_s)
-            if export_lib.is_fleet_dir(d) else run_status(d, now, stale_s))
+    if export_lib.is_fleet_dir(d):
+        return fleet_status(d, now, stale_s)
+    if (os.path.exists(os.path.join(d, "mpmd_config.json"))
+            or goodput.list_stage_dirs(d)):
+        return pipeline_status(d, now, stale_s)
+    return run_status(d, now, stale_s)
 
 
 # -------------------------------------------------------------- rendering
@@ -194,6 +274,17 @@ def render(snap: dict) -> str:
             f"{snap['completed']} completed / {snap['in_flight']} in "
             f"flight / {snap['replayed']} replayed   "
             f"ttft p50={snap['ttft_p50_s']}s p95={snap['ttft_p95_s']}s")
+    elif snap["kind"] == "pipeline":
+        headers = ["stage", "state", "attempt", "params_step", "step",
+                   "beacon_age_s", "link_wait_s", "goodput",
+                   "steady_recompiles", "attempts"]
+        out.append(_table(headers, [[r.get(h) for h in headers]
+                                    for r in snap["stages"]]))
+        out.append(
+            f"pipeline: {snap['n_stages']} stages ({snap['schedule']})   "
+            f"done step: {snap['step']}   goodput: {snap['goodput']} "
+            f"(accounted {snap['accounted_frac']}, "
+            f"link_wait {snap['link_wait_s']}s)")
     else:
         headers = ["rank", "state", "attempt", "step", "steps_per_s",
                    "beacon_age_s", "goodput", "steady_recompiles"]

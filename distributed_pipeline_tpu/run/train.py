@@ -215,10 +215,93 @@ def resume_sample_position(resume_step: int, meta, batch_size: int,
                                     process_count), consumed
 
 
+def _mpmd_main(args: TrainSettings) -> dict:
+    """MPMD pipeline training (ISSUE 16): THIS process is the jax-free
+    host driver — it writes the shared ``mpmd_config.json``, spawns one
+    supervised launcher ring PER STAGE (each with its own restart budget,
+    snapshots, and beacon watchdog — stages are independently
+    preemptible), and broadcasts the microbatch schedule over the
+    StageLink command links. The per-stage workers
+    (mpmd/stage_worker.py) own the jax math; activations and grads move
+    over the file-relay StageLink transport instead of a collective."""
+    from ..mpmd.driver import PipelineDriver
+
+    if not args.scan_layers:
+        raise SystemExit("--mpmd requires --scan_layers true (stages "
+                         "slice the stacked layer dim)")
+    if args.pp_schedule not in ("1f1b", "gpipe"):
+        raise SystemExit(
+            "--mpmd runs the host-driven 1f1b or gpipe schedule; "
+            "interleaved virtual stages are a single-program schedule "
+            "(models/schedule_1f1b.py) — drop --mpmd or switch schedules")
+    if args.learning_steps <= 0:
+        raise SystemExit("--mpmd needs --learning_steps > 0 (the host "
+                         "driver runs a bounded schedule)")
+    if args.pipe > 1:
+        raise SystemExit("--pipe is the in-program GPipe mesh axis; "
+                         "under --mpmd stages are separate processes — "
+                         "set --mpmd_stages instead")
+    ckpt_path = resolve_run_dir(args)
+    os.makedirs(ckpt_path, exist_ok=True)
+    with open(os.path.join(ckpt_path, "training_args.json"), "w") as f:
+        f.write(args.to_json())
+    if args.trace:
+        # arm tracing pipeline-wide (the fleet parent's pattern): the env
+        # rides the launcher's worker environment to every stage attempt,
+        # so stage fwd/bwd spans carry the per-microbatch trace ids that
+        # stitch the cross-process timeline
+        from ..obs.trace import TRACE_ENV
+        os.environ[TRACE_ENV] = "1"
+    flat = json.loads(args.to_json())
+    config = {
+        "n_stages": args.mpmd_stages,
+        "n_microbatches": args.pp_chunks,
+        "schedule": args.pp_schedule,
+        # create_model_from_config / load_data_from_args both swallow the
+        # full flat settings dict (the single-program path passes it
+        # verbatim too); the loader gets batch_size positionally
+        "model": flat,
+        "data": {k: v for k, v in flat.items() if k != "batch_size"},
+        "batch_size": args.batch_size,
+        "seed": args.seed,
+        "lr": args.lr,
+        "weight_decay": args.weight_decay,
+        "link_capacity": args.mpmd_link_capacity,
+    }
+    driver = PipelineDriver(
+        ckpt_path, config,
+        max_restarts=args.mpmd_max_restarts,
+        hang_timeout_s=args.mpmd_hang_timeout_s,
+        worker_platform=os.environ.get("JAX_PLATFORMS", "cpu") or "cpu",
+        trace_armed=True if args.trace else None)
+    try:
+        result = driver.run(args.learning_steps)
+    finally:
+        driver.stop()
+    with open(driver.result_path(), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        "mode": "mpmd", "stages": args.mpmd_stages,
+        "schedule": args.pp_schedule, "steps": result["steps"],
+        "final_loss": (result["losses"][-1] if result["losses"]
+                       else None),
+        "rewinds": result["rewinds"],
+        "attempts_per_stage": result["attempts_per_stage"],
+        "accounted_frac": result["goodput"].get("accounted_frac"),
+    }))
+    return result
+
+
 def main(namespace: argparse.Namespace) -> None:
     """(reference run/train.py:10-121; late imports keep ``--help`` fast,
     mirroring the reference's in-function imports at train.py:15-24)"""
     args = TrainSettings.from_argparse(namespace)
+
+    if args.mpmd:
+        # before ANY jax import: the MPMD parent is the host driver and
+        # must never initialize a backend (the stage workers pay it)
+        _mpmd_main(args)
+        return
 
     import jax
 

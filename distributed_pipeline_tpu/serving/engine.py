@@ -31,7 +31,7 @@ flash-decode Pallas kernel later replaces the gather inside
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -307,6 +307,71 @@ class DecodeEngine:
 
     def set_active(self, active: np.ndarray) -> None:
         self._active = self._put(np.ascontiguousarray(active, np.int32))
+
+    # ---------------------------------------------- page migration (disagg)
+
+    def _pool_leaves(self) -> list:
+        """(path-key, leaf) pairs for the paged K/V pool leaves of the
+        cache pytree. The pools are the only 4-D
+        ``[max_pages, page_size, H, Dh]`` leaves (backbone
+        ``_paged_attention`` creates exactly ``pages_k``/``pages_v`` per
+        layer), and ``jax.tree_util.keystr`` names each deterministically
+        — a decode engine built from the same model config on ANOTHER
+        process derives the same keys, which is what makes the
+        extract/ingest wire format stable across a StageLink."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.cache)
+        return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat
+                if (getattr(leaf, "ndim", 0) == 4
+                    and leaf.shape[0] == self.max_pages
+                    and leaf.shape[1] == self.page_size)]
+
+    def extract_pages(self, page_ids: np.ndarray) -> Dict[str, np.ndarray]:
+        """Pull the contents of ``page_ids`` out of every pool leaf as
+        host arrays keyed by leaf path — the KV payload a disaggregated
+        prefill worker ships to a decode server (mpmd/disagg.py). Page
+        ids are POSITIONAL in the result: row i holds page ``page_ids[i]``
+        — the receiver scatters the same rows at ITS OWN allocated ids."""
+        idx = np.ascontiguousarray(page_ids, np.int32)
+        return {key: np.asarray(jax.device_get(leaf[idx]))
+                for key, leaf in self._pool_leaves()}
+
+    def ingest_pages(self, page_ids: np.ndarray,
+                     pools: Dict[str, np.ndarray]) -> None:
+        """Scatter transferred pool pages (an :meth:`extract_pages`
+        payload) into this engine's cache at ``page_ids``. Functional
+        ``.at[].set`` update: in-flight decode handles keep the array
+        version they were dispatched with, same as every other state
+        transition here. Raises on a key mismatch — that means the
+        prefill and decode engines were built from different models."""
+        mine = {key for key, _ in self._pool_leaves()}
+        if set(pools) != mine:
+            raise ValueError(
+                f"pool-leaf mismatch: payload has {sorted(pools)} but this "
+                f"engine has {sorted(mine)} (prefill/decode model drift?)")
+        idx = jnp.asarray(np.ascontiguousarray(page_ids, np.int32))
+
+        def _scatter(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if key not in pools:
+                return leaf
+            return leaf.at[idx].set(jnp.asarray(pools[key], leaf.dtype))
+
+        with self._ctx():
+            self.cache = jax.tree_util.tree_map_with_path(_scatter,
+                                                          self.cache)
+
+    def set_slot_state(self, slot: int, token: int, position: int) -> None:
+        """Seed one slot's decode state by hand — the disaggregated
+        admission path's stand-in for the scatter at the tail of the
+        prefill executable (the transferred request arrives with its
+        first token and position already picked by the prefill worker).
+        Host round-trip on purpose: admission is off the decode hot path."""
+        toks = np.asarray(jax.device_get(self.tokens)).copy()
+        pos = np.asarray(jax.device_get(self.positions)).copy()
+        toks[slot] = int(token)
+        pos[slot] = int(position)
+        self.tokens = self._put(toks)
+        self.positions = self._put(pos)
 
     # ------------------------------------------------------------- phases
 

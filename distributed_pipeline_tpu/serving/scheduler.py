@@ -294,6 +294,88 @@ class DecodeServer:
         self.queue.append(req)
         return req
 
+    def submit_prefilled(self, prompt: np.ndarray, max_new_tokens: int, *,
+                         first_token: int, kv_pages: dict,
+                         eos_id: Optional[int] = None,
+                         submit_t: Optional[float] = None
+                         ) -> Optional[Request]:
+        """Admit a request whose prefill ran on ANOTHER engine (the
+        disaggregated serving path, mpmd/disagg.py): ``kv_pages`` is an
+        ``DecodeEngine.extract_pages`` payload covering the prompt's
+        ``pages_for(prompt_len)`` pages, ``first_token`` the token the
+        prefill worker already picked at ``position = prompt_len``.
+
+        Unlike :meth:`submit` this admits IMMEDIATELY (no queue): the KV
+        payload is only valid against the page ids allocated here, so
+        deferring admission would mean holding the payload host-side
+        anyway — returning None (no free slot / pool exhausted) pushes
+        the backpressure onto the caller's StageLink instead, which is
+        the flow-control channel the transfer already has. Pages come
+        straight from the PageManager (never the prefix cache: the
+        transferred pages hold remote state the local prefill executable
+        never wrote, so publishing them as a shareable prefix would hand
+        sharers pages this server cannot reproduce)."""
+        prompt = np.ascontiguousarray(prompt, np.int32).ravel()
+        if not 1 <= prompt.shape[0] <= self.engine.max_prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.shape[0]} outside [1, "
+                f"max_prompt_len={self.engine.max_prompt_len}]")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        g_max = min(max_new_tokens,
+                    self.engine.max_len - int(prompt.shape[0]))
+        if g_max < 1:
+            raise ValueError(
+                f"prompt of {prompt.shape[0]} tokens leaves no room to "
+                f"generate under max_len={self.engine.max_len}")
+        total = prompt.shape[0] + g_max
+        if self.mgr.pages_for(total) > self.mgr.capacity:
+            raise ValueError(
+                f"request needs {self.mgr.pages_for(total)} pages but the "
+                f"pool holds {self.mgr.capacity}; raise max_pages or lower "
+                f"max_new_tokens")
+        n_filled = self.mgr.pages_for(prompt.shape[0])
+        got = {k: v.shape[0] for k, v in kv_pages.items()}
+        if any(n != n_filled for n in got.values()):
+            raise ValueError(f"kv_pages rows {got} != pages_for(prompt_len)"
+                             f"={n_filled}")
+        free = [s for s in range(len(self.slots)) if self.slots[s] is None]
+        if not free:
+            return None
+        pages = self.mgr.alloc(self.mgr.pages_for(total))
+        if pages is None:
+            return None
+        slot = free[0]
+        self._req_counter += 1
+        req = Request(id=self._req_counter, prompt=prompt,
+                      max_new_tokens=max_new_tokens, g_max=g_max,
+                      eos_id=self.default_eos_id if eos_id is None else eos_id,
+                      submit_t=(time.perf_counter() if submit_t is None
+                                else submit_t))
+        self.engine.ingest_pages(pages[:n_filled], kv_pages)
+        self.engine.set_slot_state(slot, first_token, req.prompt_len)
+        self.block_tables[slot, :] = TRASH_PAGE
+        self.block_tables[slot, :len(pages)] = pages
+        self.active[slot] = 1
+        self.slots[slot] = _SlotState(req=req, pages=pages,
+                                      position=req.prompt_len)
+        self._dirty = True
+        # the transferred first token is this request's first FETCHED
+        # token too (the colocated path attributes it from the prefill
+        # ring; there is no local prefill dispatch to ride here)
+        now = time.perf_counter()
+        req.tokens.append(int(first_token))
+        self.tokens_fetched += 1
+        req.ttft_s = max(0.0, now - req.submit_t)
+        self.ttft.add(req.ttft_s)
+        if req.eos_id is not None and int(first_token) == req.eos_id:
+            req.finished = True
+        elif len(req.tokens) >= req.g_max:
+            req.finished = True
+        if req.finished or req.g_max <= 1:
+            self._release(slot)
+        return req
+
     def _release(self, slot: int) -> None:
         st = self.slots[slot]
         if st is None:

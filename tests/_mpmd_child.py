@@ -1,0 +1,147 @@
+"""Fixture MPMD stage worker for pipeline-runtime tests (no jax import).
+
+Runs the REAL :class:`~distributed_pipeline_tpu.mpmd.stage_worker.StageWorker`
+— same command loop, schedule execution, link framing, epoch fencing,
+snapshot/rewind handling, beacons, and goodput booking — with the two
+jax-side seams stubbed through ``sys.modules`` before construction
+(``StageMath`` and ``RecompileMonitor`` are the ONLY jax entry points in
+the worker, both imported lazily inside ``StageWorker.__init__``). The
+driver, protocol, and transport layers therefore get full multi-process
+end-to-end coverage in tier-1 without paying a jax import per stage
+process (the proven tests/_fleet_child.py pattern).
+
+The stand-in math is a scalar linear chain, deterministic in
+(params, step, mb) so a chaos-kill rewind replay must reproduce the
+fault-free loss sequence bit-for-bit:
+
+    x_0(step, mb) = [step + (mb+1)/4, step - (mb+1)/8]      (stage 0)
+    y_s = x_s * (w_s + e)          e = tied scalar, 0 when untied
+    loss = sum over mb of sum(y_last ** 2)                   (last stage)
+
+Backward is the exact chain rule; ``w_s`` takes a local SGD step and the
+tied ``e`` grad goes through the driver's shared-sum round (stages 0 and
+S-1, matching ``PipelineDriver.shared_stages``) so every stage applies
+the SAME summed tied grad. tests/test_mpmd.py re-implements this chain
+as a pure-python reference and asserts loss equality.
+
+Argv: --run_dir DIR --stage I --n_stages N   (the StageWorker CLI)
+"""
+
+import sys
+import types
+
+import numpy as np
+
+
+def _batch(step: int, mb: int) -> np.ndarray:
+    """The stage-0 synthetic microbatch — a pure function of (step, mb)
+    so rewind replays regenerate identical data."""
+    return np.array([step + (mb + 1) / 4.0, step - (mb + 1) / 8.0],
+                    dtype=np.float64)
+
+
+class FakeStageMath:
+    """Drop-in for ``mpmd.stage_math.StageMath``: the exact surface the
+    worker protocol loop touches, with scalar-chain math behind it."""
+
+    def __init__(self, config, stage):
+        self.config = config
+        self.stage = int(stage)
+        self.n_stages = int(config["n_stages"])
+        self.is_first = self.stage == 0
+        self.is_last = self.stage == self.n_stages - 1
+        self.lr = float(config.get("lr", 0.01))
+        self.tied = (bool(config.get("tied_embedding", False))
+                     and (self.is_first or self.is_last))
+        self.w = 0.5 + 0.25 * self.stage
+        self.e = 0.1 if self.tied else 0.0
+        self._stash = {}
+        self._loss = 0.0
+        self._gw = 0.0
+        self._ge = 0.0
+        self.step = 0
+
+    # ------------------------------------------------------------- step
+    def start_step(self, step, n_mb):
+        self.step = int(step)
+        self._stash = {}
+        self._loss = 0.0
+        self._gw = 0.0
+        self._ge = 0.0
+
+    def forward_mb(self, mb, inb):
+        x = _batch(self.step, mb) if inb is None else \
+            np.asarray(inb["x"], dtype=np.float64)
+        y = x * (self.w + self.e)
+        self._stash[mb] = (x, y)
+        if self.is_last:
+            self._loss += float(np.sum(y * y))
+        return {"x": y}
+
+    def backward_mb(self, mb, inb):
+        x, y = self._stash[mb]
+        dy = 2.0 * y if inb is None else \
+            np.asarray(inb["g"], dtype=np.float64)
+        g = float(np.sum(dy * x))
+        self._gw += g
+        self._ge += g
+        return {"g": dy * (self.w + self.e)}
+
+    # ------------------------------------------------------- tied grads
+    def shared_grads(self):
+        if not self.tied:
+            return None
+        return {"e": np.array([self._ge], dtype=np.float64)}
+
+    def apply(self, shared_sum):
+        self.w -= self.lr * self._gw
+        if self.tied and shared_sum is not None:
+            self.e -= self.lr * float(np.asarray(shared_sum["e"])[0])
+        return {"loss_partial": self._loss if self.is_last else 0.0}
+
+    # -------------------------------------------------------- snapshots
+    def export_flat(self):
+        return {"w": np.array([self.w], dtype=np.float64),
+                "e": np.array([self.e], dtype=np.float64)}
+
+    def load_flat(self, flat):
+        self.w = float(np.asarray(flat["w"])[0])
+        self.e = float(np.asarray(flat["e"])[0])
+
+
+def _install_stubs():
+    """Shadow the worker's two lazy jax-side imports. Must run before
+    ``StageWorker.__init__``; ``from ..utils.perf import RecompileMonitor``
+    and ``from .stage_math import StageMath`` both resolve through
+    ``sys.modules`` first, so the real modules (and jax) never load."""
+    perf = types.ModuleType("distributed_pipeline_tpu.utils.perf")
+
+    class _FakeMonitor:
+        count = 0
+
+        def install(self):
+            return self
+
+    perf.RecompileMonitor = _FakeMonitor
+    sys.modules["distributed_pipeline_tpu.utils.perf"] = perf
+
+    sm = types.ModuleType("distributed_pipeline_tpu.mpmd.stage_math")
+    sm.StageMath = FakeStageMath
+    sys.modules["distributed_pipeline_tpu.mpmd.stage_math"] = sm
+
+
+def main(argv=None) -> int:
+    _install_stubs()
+    from distributed_pipeline_tpu.mpmd.stage_worker import (  # noqa: E402
+        StageWorker, main as worker_main)
+    assert StageWorker is not None  # the real worker, stubs underneath
+    rc = worker_main(argv)
+    if "jax" in sys.modules:  # the whole point of this fixture
+        print("_mpmd_child: jax leaked into the stand-in worker",
+              file=sys.stderr)
+        return 3
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

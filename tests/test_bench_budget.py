@@ -95,8 +95,8 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
     # (headline + prefetch A/B twin + zero1 A/B + trace A/B + chaos +
-    # elastic + tune + noaccum + moe8 + moe8-cf1 + scan)
-    assert len(final["configs"]) == 11
+    # elastic + tune + mpmd-pipe + noaccum + moe8 + moe8-cf1 + scan)
+    assert len(final["configs"]) == 12
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
