@@ -895,6 +895,202 @@ def main() -> None:
             "leg_wall_s": round(wall, 1),
         }
 
+    def measure_serve_autoscale(name: str, *, requests: int = 20,
+                                rate_rps: float = 0.8,
+                                diurnal_period_s: float = 20.0,
+                                max_replicas: int = 2,
+                                gen_tokens: int = 8, prompt_len: int = 12,
+                                shared_prefix_len: int = 8,
+                                page_size: int = 4, seq_len: int = 32,
+                                decode_slots: int = 2,
+                                # the autoscaler's internal SLO target —
+                                # deliberately TIGHT so the warmup-window
+                                # queue waits breach it and drive the
+                                # scale-up; the leg's own acceptance
+                                # bounds are the documented CPU SLOs below
+                                slo_ttft_s: float = 1.0,
+                                slo_p50_s: float = 10.0,
+                                slo_p95_s: float = 60.0,
+                                timeout_s: float = 200.0):
+        """Autoscaling-fleet leg (ISSUE 17): three fleet runs over the
+        SAME seeded diurnal + shared-prefix workload. (1) a static
+        max-size fleet with least-loaded routing — the replica-seconds
+        baseline AND the prefix-hit-rate control; (2) the same static
+        fleet with prefix-affinity routing ON — the fleet-wide-cache
+        A/B arm; (3) --replicas 1 under the SLO-driven autoscaler
+        (ceiling max_replicas): the startup/peak pressure must journal
+        >= 1 scale-up, the diurnal trough >= 1 drain-based scale-down.
+        Acceptance: zero drops everywhere, p50/p95 TTFT inside the
+        documented CPU bounds, the autoscaled run's summed replica
+        wall (its replica-seconds bill) strictly below the static
+        baseline's, affinity's fleet-wide prefix hit rate strictly
+        above least-loaded's, and the serving ledger closing at
+        accounted_frac 1.0 WITH the paid_idle category booked. Run
+        order is cold-cache-fair: the affinity arm pays the one cold
+        compile; the two runs being compared (static vs autoscale)
+        both start warm."""
+        import shutil
+        import subprocess
+
+        run_dir = os.path.abspath(
+            os.path.join("model_checkpoints", "bench", "autoscale_run"))
+        shutil.rmtree(run_dir, ignore_errors=True)
+        dims = dict(hidden_size=32, num_layers=2, num_heads=2,
+                    vocab_size=64)
+        wl = create_model_from_config(
+            model_family="gpt2", model_size="base", seq_len=seq_len,
+            dtype="float32", **dims)
+        data = load_data_from_args(
+            "train", batch_size=8, dataset="synthetic-lm",
+            seq_len=seq_len, vocab_size=dims["vocab_size"], seed=0)
+        loop = TrainLoop(model=wl, data=data, batch_size=8, lr=1e-3,
+                         ema_rate="0.99", learning_steps=0,
+                         log_interval=10 ** 9, save_interval=10 ** 9,
+                         checkpoint_dir=run_dir)
+        for _ in range(2):
+            loop.run_step(next(loop.data))
+        loop.save()
+        loop.wait_for_saves()
+        with open(os.path.join(run_dir, "training_args.json"), "w") as f:
+            json.dump(dict(model_family="gpt2", model_size="base",
+                           seq_len=seq_len, dtype="float32",
+                           dataset="synthetic-lm", seed=0, **dims), f)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("DPT_CHAOS_PLAN", None)
+
+        def fleet_run(tag, extra):
+            fleet_dir = os.path.join(run_dir, f"fleet_{tag}")
+            cmd = [sys.executable, "-m",
+                   "distributed_pipeline_tpu.run.serve",
+                   "--checkpoint_path", run_dir, "--step", "2",
+                   "--fleet_dir", fleet_dir,
+                   "--decode_slots", str(decode_slots),
+                   "--page_size", str(page_size),
+                   "--max_prompt_len", str(prompt_len),
+                   "--max_new_tokens", str(gen_tokens),
+                   "--synthetic_prompt_len", str(prompt_len),
+                   "--synthetic_requests", str(requests),
+                   "--shared_prefix_len", str(shared_prefix_len),
+                   "--prefix_cache", "true",
+                   "--traffic", "diurnal", "--rate_rps", str(rate_rps),
+                   "--diurnal_period_s", str(diurnal_period_s),
+                   "--diurnal_floor", "0.05",
+                   # a wide teardown margin: a deadline-hit run must
+                   # still drain + stop + print its row inside timeout_s
+                   "--fleet_deadline_s",
+                   str(max(60.0, timeout_s - 60.0))] + extra
+            proc = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+                start_new_session=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            try:
+                out, err = proc.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                proc.wait()
+                return None, f"{tag} run exceeded {timeout_s:.0f}s"
+            if proc.returncode != 0 or not out.strip():
+                return None, (f"{tag} run failed "
+                              f"(rc={proc.returncode}): "
+                              f"{(err or out or '')[-300:]}")
+            return json.loads(out.strip().splitlines()[-1]), None
+
+        t0 = time.perf_counter()
+        static_n = str(max_replicas)
+        affinity, err = fleet_run("affinity", [
+            "--replicas", static_n, "--route_affinity", "true"])
+        if err is None:
+            static, err = fleet_run("static", ["--replicas", static_n])
+        if err is None:
+            auto, err = fleet_run("autoscale", [
+                "--replicas", "1", "--route_affinity", "true",
+                "--autoscale", "true",
+                "--autoscale_min", "1",
+                "--autoscale_max", static_n,
+                "--autoscale_slo_ttft_s", str(slo_ttft_s),
+                "--autoscale_up_backlog", "2.0",
+                "--autoscale_down_frac", "0.5",
+                "--autoscale_cooldown_s", "2.0",
+                "--autoscale_window_s", "6.0"])
+        wall = time.perf_counter() - t0
+        if err is not None:
+            return {"name": name, "error": err,
+                    "leg_wall_s": round(wall, 1)}
+
+        asc = auto.get("autoscale") or {}
+        auto_gp = auto.get("serving_goodput") or {}
+        static_gp = static.get("serving_goodput") or {}
+        failures = []
+        for tag, res in (("affinity", affinity), ("static", static),
+                         ("autoscale", auto)):
+            if res.get("dropped"):
+                failures.append(f"{tag}: {res['dropped']} requests "
+                                f"dropped")
+            gp = res.get("serving_goodput") or {}
+            if abs(gp.get("accounted_frac", 0.0) - 1.0) > 0.05:
+                failures.append(f"{tag}: ledger unaccounted "
+                                f"(frac={gp.get('accounted_frac')})")
+        if not asc.get("scale_ups"):
+            failures.append("no scale-up journaled")
+        if not asc.get("scale_downs"):
+            failures.append("no drain-based scale-down journaled")
+        p50, p95 = auto.get("ttft_p50_s"), auto.get("ttft_p95_s")
+        if p50 is None or p50 > slo_p50_s or p95 > slo_p95_s:
+            failures.append(f"TTFT SLO breach: p50={p50} "
+                            f"(<= {slo_p50_s}) p95={p95} "
+                            f"(<= {slo_p95_s})")
+        # replica-seconds: summed replica wall — the bill an operator
+        # pays. The autoscaled fleet must cost less than always-max.
+        auto_rs = auto_gp.get("wall_s") or 0.0
+        static_rs = static_gp.get("wall_s") or 0.0
+        if not auto_rs or not static_rs or auto_rs >= static_rs:
+            failures.append(f"autoscale replica-seconds {auto_rs} did "
+                            f"not beat static-max {static_rs}")
+        hit_aff = affinity.get("prefix_hit_rate") or 0.0
+        hit_ll = static.get("prefix_hit_rate") or 0.0
+        if hit_aff <= hit_ll:
+            failures.append(f"affinity hit rate {hit_aff} did not beat "
+                            f"least-loaded {hit_ll}")
+        if failures:
+            return {"name": name, "error": "; ".join(failures)[:500],
+                    "autoscale": asc, "ttft_p50_s": p50,
+                    "ttft_p95_s": p95, "leg_wall_s": round(wall, 1)}
+        return {
+            "name": name,
+            "requests": auto["requests"],
+            "completed": auto["completed"],
+            "dropped": auto["dropped"],
+            "scale_ups": asc["scale_ups"],
+            "scale_downs": asc["scale_downs"],
+            "max_replicas": max_replicas,
+            "ttft_p50_s": p50,
+            "ttft_p95_s": p95,
+            "slo_p50_s": slo_p50_s,
+            "slo_p95_s": slo_p95_s,
+            "autoscale_slo_ttft_s": slo_ttft_s,
+            "replica_seconds": round(auto_rs, 2),
+            "static_replica_seconds": round(static_rs, 2),
+            "replica_seconds_saved_frac": round(
+                1.0 - auto_rs / static_rs, 4),
+            "paid_idle_s": auto_gp.get("paid_idle_s"),
+            "serving_s": auto_gp.get("serving_s"),
+            "accounted_frac": auto_gp.get("accounted_frac"),
+            "prefix_hit_rate_affinity": hit_aff,
+            "prefix_hit_rate_least_loaded": hit_ll,
+            "affinity_hits": affinity.get("affinity_hits"),
+            "affinity_placements": affinity.get("affinity_placements"),
+            "traffic": auto.get("traffic"),
+            "wall_s": auto.get("wall_s"),
+            "leg_wall_s": round(wall, 1),
+        }
+
     def measure_mpmd_pipe(name: str, *, steps: int = 3, n_stages: int = 2,
                           n_microbatches: int = 4, batch: int = 8,
                           seq_len: int = 128, hidden: int = 64,
@@ -1702,6 +1898,19 @@ def main() -> None:
         ("gpt2-serve-disagg", functools.partial(
             measure_serve_disagg, "gpt2-serve-disagg",
             requests=8, gen_tokens=6, rate_rps=6.0, burst_size=4)),
+        # Autoscaling fleet leg (ISSUE 17): seeded diurnal traffic over
+        # a shared-prefix workload, three fleet runs on one checkpoint —
+        # prefix-affinity A/B arm, static-max baseline, and --replicas 1
+        # under the SLO autoscaler. Acceptance: >= 1 journaled scale-up
+        # AND drain-based scale-down, zero drops, p95 TTFT inside the
+        # documented CPU SLO, the autoscaled replica-seconds bill below
+        # static-max, affinity's fleet-wide prefix hit rate above
+        # least-loaded's, and every ledger closing at accounted_frac
+        # 1.0 with paid_idle booked.
+        ("gpt2-serve-autoscale", functools.partial(
+            measure_serve_autoscale, "gpt2-serve-autoscale",
+            requests=20, rate_rps=0.8, diurnal_period_s=20.0,
+            max_replicas=2, gen_tokens=8)),
         # no-accumulation variant (pure config-2 semantics)
         ("diffuseq-base-seq128-noaccum", functools.partial(
             measure, "diffuseq-base-seq128-noaccum", family="diffuseq",

@@ -34,7 +34,8 @@ workers write ``serving_attempt{A:03d}.json`` sidecars (clean exit) and a
 ``serving`` snapshot inside their beacons (the kill flight recorder).
 :func:`aggregate_serving` folds the whole fleet into::
 
-    serving wall == serving + drain + replay + swap + downtime + lost
+    serving wall == serving + drain + replay + paid_idle + swap
+                    + downtime + lost
 
 with ``accounted_frac == 1.0`` by construction — ``replay`` is the
 serving-shaped time whose output was thrown away (work a killed replica
@@ -426,7 +427,8 @@ def _aggregate_pipeline(stage_dirs: List[str]) -> Dict[str, Any]:
 def aggregate_serving(fleet_dir: str) -> Dict[str, Any]:
     """Fold a serving fleet's artifacts into one ledger::
 
-        wall == serving + drain + replay + swap + downtime + lost
+        wall == serving + drain + replay + paid_idle + swap
+                + downtime + lost
 
     ``wall`` is summed REPLICA wall (each replica's first-spawn ->
     last-exit span, which the launcher's attempt records decompose into
@@ -442,8 +444,10 @@ def aggregate_serving(fleet_dir: str) -> Dict[str, Any]:
     stays exact — note the windows are PER REQUEST and may overlap the
     same wall period (N requests in flight on one killed replica each
     book their own assign->death window), so under heavy replay the
-    clamp can consume all of ``serving``. Degrades, never raises, like
-    :func:`aggregate_run`.
+    clamp can consume all of ``serving``. ``paid_idle`` — the
+    autoscaler's journaled unneeded-capacity seconds — is re-booked out
+    of ``serving`` with the same clamp discipline (zero when no
+    autoscaler ran). Degrades, never raises, like :func:`aggregate_run`.
     """
     serving = drain = swap = lost = downtime = wall = 0.0
     per_replica: List[dict] = []
@@ -494,13 +498,25 @@ def aggregate_serving(fleet_dir: str) -> Dict[str, Any]:
         if ev.get("ev") == "replay")
     replay = min(max(0.0, replay_raw), serving)
     serving -= replay
+    # Autoscaler-attributed paid idle: replica-seconds that were up and
+    # ready but UNNEEDED (idle beyond the scaler's floor with an empty
+    # queue). Same re-booking discipline as replay: journal deltas
+    # summed, clamped against what is left of `serving`, identity exact.
+    paid_idle_raw = sum(
+        _fnum(ev.get("idle_s"))
+        for ev in read_journal(serving_journal_path(fleet_dir))
+        if ev.get("ev") == "paid_idle")
+    paid_idle = min(max(0.0, paid_idle_raw), serving)
+    serving -= paid_idle
     wall = max(wall, 1e-9)
-    accounted = serving + drain + replay + swap + downtime + lost
+    accounted = (serving + drain + replay + paid_idle + swap + downtime
+                 + lost)
     return {
         "wall_s": wall,
         "serving_s": serving,
         "drain_s": drain,
         "replay_s": replay,
+        "paid_idle_s": paid_idle,
         "swap_s": swap,
         "downtime_s": downtime,
         "lost_s": lost,

@@ -154,6 +154,47 @@ class ServeSettings(S):
                             "kill_replica / stall_replica / "
                             "corrupt_swap_checkpoint); also honors the "
                             "DPT_CHAOS_PLAN env like training")
+    serve_transport: Literal["file", "socket"] = _(
+        "file", "replica data-plane transport (ISSUE 17): 'file' = "
+                "atomic-rename mailboxes + beacon-mtime liveness (the "
+                "proven single-host default); 'socket' = length-prefixed "
+                "JSON frames over TCP + heartbeat liveness (replicas can "
+                "live on other hosts). The ctrl plane (ready/swap/stop/"
+                "beacons) stays file-based either way, so hot-swap, the "
+                "hang watchdog and goodput accounting are identical")
+    route_affinity: bool = _(
+        False, "prefix-affinity routing: place each request on the "
+               "replica whose advertised prefix-cache index matches the "
+               "most leading page-aligned prompt blocks (falls back to "
+               "least-loaded on ties/cold prefixes); pair with "
+               "--prefix_cache for the fleet-wide cache win")
+
+    # ----------------------------------------------- autoscale (ISSUE 17)
+    autoscale: bool = _(
+        False, "SLO-driven autoscaler (serving/autoscale.py): grow the "
+               "replica set when backlog/TTFT breach the SLO, shrink it "
+               "via the drain path when idle; --replicas is the "
+               "INITIAL size")
+    autoscale_min: int = _(1, "autoscaler floor (never drain below this "
+                              "many active replicas)")
+    autoscale_max: int = _(0, "autoscaler ceiling (0 = the initial "
+                              "--replicas count, i.e. scale-down only)")
+    autoscale_slo_ttft_s: float = _(
+        10.0, "the TTFT SLO target: windowed p95 above this (or backlog "
+              "above autoscale_up_backlog per ready replica) scales UP")
+    autoscale_up_backlog: float = _(
+        2.0, "scale-up pressure threshold: pending requests per ready "
+             "replica")
+    autoscale_down_frac: float = _(
+        0.5, "hysteresis band: scale DOWN only when backlog is zero and "
+             "windowed p95 TTFT sits below down_frac * slo (strictly "
+             "below the up threshold, so bursts can't flap the fleet)")
+    autoscale_cooldown_s: float = _(
+        5.0, "minimum seconds between structural changes (either "
+             "direction)")
+    autoscale_window_s: float = _(
+        30.0, "trailing window over completed requests feeding the "
+              "p95-TTFT signal")
 
     # -------------------------------------------- disaggregation (ISSUE 16)
     disagg: int = _(0, "disaggregated prefill/decode serving (mpmd/"

@@ -43,13 +43,53 @@ import numpy as np
 
 from .link import MemStageLink, flatten_tree, unflatten_tree
 
-__all__ = ["StageMath", "run_pipeline_inprocess", "stage_param_bounds"]
+__all__ = ["StageMath", "run_pipeline_inprocess", "stage_param_bounds",
+           "stage_param_slice"]
 
 
 def stage_param_bounds(num_layers: int, stage: int, n_stages: int):
     """Contiguous layer slice [lo, hi) for one stage (balanced split)."""
     return (stage * num_layers // n_stages,
             (stage + 1) * num_layers // n_stages)
+
+
+def stage_param_slice(p: Dict[str, Any], family: str, lo: int, hi: int,
+                      is_first: bool, is_last: bool) -> Dict[str, Any]:
+    """One stage's parameter slice of a FULL unboxed init tree
+    (``wl.init_params(...)["params"]``). Pure tree surgery — shared by
+    the sliced-init jit below and the bit-identity test, so the two
+    paths cannot drift."""
+    import jax
+
+    blocks = jax.tree_util.tree_map(lambda a: a[lo:hi],
+                                    dict(p["backbone"]["blocks"]))
+    params: Dict[str, Any] = {"blocks": blocks}
+    if family == "gpt2":
+        if is_first:
+            params["word_emb"] = p["word_emb"]["embedding"]
+            params["pos_emb"] = p["pos_emb"]
+        if is_last:
+            params["word_emb"] = p["word_emb"]["embedding"]
+            params["ln_f_scale"] = p["backbone"]["ln_f"]["scale"]
+            params["ln_f_bias"] = p["backbone"]["ln_f"]["bias"]
+    else:  # diffuseq
+        if is_first:
+            params.update({
+                "word_emb": p["word_emb"]["embedding"],
+                "in_w": p["in_proj"]["kernel"],
+                "in_b": p["in_proj"]["bias"],
+                "t0_w": p["time_mlp"]["layers_0"]["kernel"],
+                "t0_b": p["time_mlp"]["layers_0"]["bias"],
+                "t1_w": p["time_mlp"]["layers_2"]["kernel"],
+                "t1_b": p["time_mlp"]["layers_2"]["bias"],
+                "pos_emb": p["pos_emb"]})
+        if is_last:
+            params.update({
+                "ln_f_scale": p["backbone"]["ln_f"]["scale"],
+                "ln_f_bias": p["backbone"]["ln_f"]["bias"],
+                "out_w": p["out_proj"]["kernel"],
+                "out_b": p["out_proj"]["bias"]})
+    return params
 
 
 def _chunk(arr, n_mb: int, mb: int):
@@ -88,43 +128,21 @@ class StageMath:
                      and self.stage in (0, self.n_stages - 1))
         self.shared_keys = ["word_emb"] if self.tied else []
 
-        # --- full init from the trainer's exact seed derivation, then slice
+        # --- sliced init (r18 NOTE follow-up): the FULL init graph still
+        # defines every value (trainer's exact seed derivation — slicing
+        # a smaller model's init would hit different RNG streams), but
+        # the slice happens INSIDE the jit, so XLA dead-code-eliminates
+        # whatever this stage never keeps: a middle xl stage never
+        # materializes the vocab embedding or the other stages' layer
+        # ranges. Bit-identical to slicing a materialized full init
+        # (same graph, same values) — proven by the test suite.
         seed = int(config.get("seed", 0))
         init_rng = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
-        full = jax.jit(lambda r: nn.meta.unbox(wl.init_params(r)))(init_rng)
-        p = full["params"]
-        L = wl.num_layers
-        lo, hi = stage_param_bounds(L, self.stage, self.n_stages)
-        blocks = jax.tree_util.tree_map(lambda a: a[lo:hi],
-                                        dict(p["backbone"]["blocks"]))
-        params: Dict[str, Any] = {"blocks": blocks}
-        if self.family == "gpt2":
-            if self.is_first:
-                params["word_emb"] = p["word_emb"]["embedding"]
-                params["pos_emb"] = p["pos_emb"]
-            if self.is_last:
-                params["word_emb"] = p["word_emb"]["embedding"]
-                params["ln_f_scale"] = p["backbone"]["ln_f"]["scale"]
-                params["ln_f_bias"] = p["backbone"]["ln_f"]["bias"]
-        else:  # diffuseq
-            if self.is_first:
-                params.update({
-                    "word_emb": p["word_emb"]["embedding"],
-                    "in_w": p["in_proj"]["kernel"],
-                    "in_b": p["in_proj"]["bias"],
-                    "t0_w": p["time_mlp"]["layers_0"]["kernel"],
-                    "t0_b": p["time_mlp"]["layers_0"]["bias"],
-                    "t1_w": p["time_mlp"]["layers_2"]["kernel"],
-                    "t1_b": p["time_mlp"]["layers_2"]["bias"],
-                    "pos_emb": p["pos_emb"]})
-            if self.is_last:
-                params.update({
-                    "ln_f_scale": p["backbone"]["ln_f"]["scale"],
-                    "ln_f_bias": p["backbone"]["ln_f"]["bias"],
-                    "out_w": p["out_proj"]["kernel"],
-                    "out_b": p["out_proj"]["bias"]})
-        self.params = params
-        del full, p
+        lo, hi = stage_param_bounds(wl.num_layers, self.stage,
+                                    self.n_stages)
+        self.params = jax.jit(lambda r: stage_param_slice(
+            nn.meta.unbox(wl.init_params(r))["params"], self.family,
+            lo, hi, self.is_first, self.is_last))(init_rng)
 
         # --- per-slice adamw: trainer._make_optimizer with the constant-lr
         # schedule arm (learning_steps == 0, no warmup)

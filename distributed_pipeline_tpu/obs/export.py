@@ -228,6 +228,9 @@ def journal_counts(events: List[dict]) -> dict:
     where: Dict[int, int] = {}  # req id -> replica currently assigned
     replays = 0
     ttfts: List[float] = []
+    affinity_hits = 0
+    scale_ups = scale_downs = 0
+    paid_idle_s = 0.0
     for ev in events:
         kind = ev.get("ev")
         try:
@@ -237,10 +240,19 @@ def journal_counts(events: List[dict]) -> dict:
         if kind == "submit" and rid is not None:
             subs.add(rid)
         elif kind == "assign" and rid is not None:
+            if _fnum(ev.get("affinity")) > 0:
+                affinity_hits += 1
             try:
                 where[rid] = int(ev.get("replica"))
             except (TypeError, ValueError):
                 pass
+        elif kind == "scale":
+            if ev.get("dir") == "up":
+                scale_ups += 1
+            elif ev.get("dir") == "down":
+                scale_downs += 1
+        elif kind == "paid_idle":
+            paid_idle_s += _fnum(ev.get("idle_s"))
         elif kind == "complete" and rid is not None:
             done.add(rid)
             where.pop(rid, None)
@@ -264,6 +276,10 @@ def journal_counts(events: List[dict]) -> dict:
                        if ttfts else None),
         "ttft_p95_s": (round(percentile(ttfts, 0.95), 4)
                        if ttfts else None),
+        "affinity_hits": affinity_hits,
+        "scale_ups": scale_ups,
+        "scale_downs": scale_downs,
+        "paid_idle_s": round(paid_idle_s, 4),
     }
 
 
@@ -328,6 +344,13 @@ def _journal_events(fleet_dir: str) -> List[dict]:
             events.append({"ph": "i", "name": "replica_down",
                            "cat": "replay", "t": t,
                            "args": {"replica": ev.get("replica")}})
+        elif kind == "scale":
+            events.append({"ph": "i",
+                           "name": f"scale_{ev.get('dir')}",
+                           "cat": "autoscale", "t": t,
+                           "args": {"replica": ev.get("replica"),
+                                    "reason": ev.get("reason"),
+                                    "n_active": ev.get("n_active")}})
     return events
 
 
@@ -576,6 +599,13 @@ def _prom_fleet(p: _Prom, fleet_dir: str, now: float) -> None:
                           {**lab, "category": cat[:-2]},
                           help_="in-attempt serving-time decomposition "
                                 "from the replica's beacon")
+            if b.get("prefix_hits") is not None:
+                p.add("dpt_replica_prefix_cache_total",
+                      b.get("prefix_hits"), {**lab, "kind": "hit"},
+                      help_="prefix-cache hits/misses advertised on the "
+                            "replica's beacon")
+                p.add("dpt_replica_prefix_cache_total",
+                      b.get("prefix_misses"), {**lab, "kind": "miss"})
         attempts = goodput.read_attempts(rd)
         if attempts:
             p.add("dpt_replica_attempts_total", len(attempts), lab)
@@ -600,11 +630,18 @@ def _prom_fleet(p: _Prom, fleet_dir: str, now: float) -> None:
                   help_="time-to-first-token from journal completions")
             p.add("dpt_ttft_seconds", counts["ttft_p95_s"],
                   {"quantile": "0.95"})
+        p.add("dpt_affinity_hits_total", counts["affinity_hits"],
+              help_="placements won by a warm advertised prefix")
+        p.add("dpt_scale_events_total", counts["scale_ups"],
+              {"dir": "up"},
+              help_="autoscaler structural changes from the journal")
+        p.add("dpt_scale_events_total", counts["scale_downs"],
+              {"dir": "down"})
     agg = goodput.aggregate_serving(fleet_dir)
     if agg["attempts"]:
         p.add("dpt_serving_accounted_frac", agg["accounted_frac"])
-        for cat in ("serving_s", "drain_s", "replay_s", "swap_s",
-                    "downtime_s", "lost_s"):
+        for cat in ("serving_s", "drain_s", "replay_s", "paid_idle_s",
+                    "swap_s", "downtime_s", "lost_s"):
             p.add("dpt_serving_seconds", agg[cat],
                   {"category": cat[:-2]},
                   help_="fleet serving ledger decomposition (seconds)")

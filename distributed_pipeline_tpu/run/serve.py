@@ -291,7 +291,8 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     rid = settings.replica_id
     paths = ReplicaPaths.at(settings.fleet_worker_dir, rid)
     proto = WorkerProtocol(paths, rid,
-                           trace_armed=True if settings.trace else None)
+                           trace_armed=True if settings.trace else None,
+                           transport=settings.serve_transport)
     pin = proto.startup()  # inbox cleared; params pin from a prior swap
 
     plan = _resolve_chaos_plan(settings)
@@ -385,6 +386,34 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     completed = 0
     tokens_out = 0
     current_step = [step]
+
+    # Prefix-affinity advertisement: a bounded LRU of the page-aligned
+    # prefix-block hashes this replica has served, riding every beacon
+    # (file transport) and heartbeat (socket transport) so the router can
+    # score warm placements. Only meaningful with the prefix cache on —
+    # advertising warmth without a cache would just skew placement.
+    import collections as _collections
+
+    from ..serving.transport import prefix_block_hashes
+    prefix_index: dict = _collections.OrderedDict()
+
+    def _index_prefix(prompt) -> None:
+        if not settings.prefix_cache:
+            return
+        for h in prefix_block_hashes(prompt, settings.page_size):
+            prefix_index.pop(h, None)
+            prefix_index[h] = None
+        while len(prefix_index) > 256:
+            prefix_index.popitem(last=False)
+
+    def _beacon_extra() -> dict:
+        if not settings.prefix_cache:
+            return {}
+        stats = server.prefix_stats()
+        return {"prefix_index": list(prefix_index),
+                "prefix_hits": int(stats.get("prefix_hits", 0)),
+                "prefix_misses": int(stats.get("prefix_misses", 0))}
+
     proto.write_beacon(tick)
     proto.announce_ready(step)
     print(f"[serve-worker {rid}] ready at step {step} "
@@ -458,6 +487,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
                 payload["_t_local"] = time.time()
                 in_flight[int(payload["id"])] = (req, payload)
                 proto.consume(int(payload["id"]))
+                _index_prefix(np.asarray(payload["prompt"], np.int32))
                 admitted += 1
                 moved = True
             if server.busy:
@@ -465,7 +495,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
                 moved = True
             _report_done()
             tick += 1
-            proto.write_beacon(tick)
+            proto.write_beacon(tick, extra=_beacon_extra())
             _write_ledger()
             if not moved:
                 time.sleep(0.005)
@@ -484,6 +514,8 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
                "tokens": tokens_out, "params_step": current_step[0],
                **server.prefix_stats()}
     proto.write_sidecar(summary)
+    proto.close()  # data-plane endpoint down AFTER the final results
+    #                were drained by the router (it polls until all done)
     print(f"[serve-worker {rid}] stopping: {json.dumps(summary)}",
           file=sys.stderr, flush=True)
     return summary
@@ -749,6 +781,46 @@ def _disagg_decode_main(settings: ServeSettings) -> dict:
 
 # ========================================================= fleet supervisor
 
+def fleet_workload(settings: ServeSettings, vocab: int,
+                   max_prompt_len: int):
+    """THE fleet workload builder (r13 NOTE closed): jax-free, and the
+    deterministic-order contract lives here, pinned by a cross-process
+    test. Returns ``(gen, reqs)`` with ``reqs`` a list of
+    ``(arrival_offset_s, prompt, max_new_tokens)`` in SUBMISSION order.
+
+    With ``--prompt_file``, prompt i (file order) rides the i-th
+    smallest arrival offset of the seeded generator — file order IS
+    submission order, and for a fixed seed the whole (offset, prompt)
+    pairing is identical in every process. Knobs fleet mode cannot honor
+    fail LOUDLY instead of silently degrading: ``--arrival_every_steps``
+    is a scheduler-step cadence, and the fleet parent has no scheduler
+    steps to count (``--traffic steps`` itself degrades to poisson
+    arrivals, which only reshapes TIMING, never order)."""
+    if settings.arrival_every_steps > 0:
+        raise SystemExit(
+            "--arrival_every_steps is a single-server scheduler-step "
+            "cadence; the fleet parent has no scheduler steps to count. "
+            "Use --traffic poisson/bursty/diurnal with --rate_rps "
+            "instead (prompt-file order is preserved either way)")
+    gen = _generator(settings, default="poisson")
+    if settings.prompt_file:
+        pairs = _load_requests(settings, max_prompt_len, vocab)
+        offsets = gen.schedule(len(pairs))
+        reqs = [(float(offsets[i]), p, n or settings.max_new_tokens)
+                for i, (p, n) in enumerate(pairs)]
+    else:
+        plen = min(settings.synthetic_prompt_len or max_prompt_len,
+                   max_prompt_len)
+        reqs = [(r.t, r.prompt, r.max_new_tokens)
+                for r in gen.requests(
+                    settings.synthetic_requests, vocab_size=vocab,
+                    prompt_len=plen,
+                    max_new_tokens=settings.max_new_tokens,
+                    shared_prefix_len=min(settings.shared_prefix_len,
+                                          plen))]
+    return gen, reqs
+
+
 def _fleet_main(settings: ServeSettings) -> dict:
     """N replicas behind the router, driven by a wall-clock traffic
     process; optional mid-run checkpoint hot-swap; serving goodput ledger
@@ -800,6 +872,14 @@ def _fleet_main(settings: ServeSettings) -> dict:
         if settings.disagg != 1:
             raise SystemExit("--disagg supports exactly one decode ring "
                              f"(got {settings.disagg})")
+        if settings.serve_transport != "file":
+            raise SystemExit("--serve_transport socket is not supported "
+                             "with --disagg (the disagg tiers speak "
+                             "StageLinks between themselves)")
+        if settings.autoscale:
+            raise SystemExit("--autoscale cannot resize a disaggregated "
+                             "fleet: the prefill peer count is pinned "
+                             "into the decode ring's link topology")
         if settings.swap_after_requests > 0:
             # a hot-swap would drain the prefill tier while the decode
             # tier still holds transferred KV computed by OLD params —
@@ -820,6 +900,9 @@ def _fleet_main(settings: ServeSettings) -> dict:
     platform = settings.replica_platform
     if platform == "auto":
         platform = os.environ.get("JAX_PLATFORMS", "")
+    # build the workload BEFORE spawning anything: a knob fleet mode
+    # cannot honor must abort with zero worker processes to clean up
+    gen, reqs = fleet_workload(settings, vocab, max_prompt_len)
     fleet = ServingFleet(
         fleet_dir, settings.replicas,
         "distributed_pipeline_tpu.run.serve", argv_prefill,
@@ -827,7 +910,8 @@ def _fleet_main(settings: ServeSettings) -> dict:
         hang_timeout_s=settings.hang_timeout_s,
         max_restarts=settings.fleet_max_restarts,
         restart_backoff_s=settings.fleet_backoff_s,
-        replica_platform=platform)
+        replica_platform=platform,
+        transport=settings.serve_transport)
     fleet.start()
     if settings.disagg > 0:
         decode_fleet = ServingFleet(
@@ -841,24 +925,30 @@ def _fleet_main(settings: ServeSettings) -> dict:
             replica_platform=platform)
         decode_fleet.start()
     router = Router(fleet.clients(), goodput.serving_journal_path(fleet_dir),
-                    stale_beacon_s=settings.stale_beacon_s)
+                    stale_beacon_s=settings.stale_beacon_s,
+                    affinity=settings.route_affinity,
+                    page_size=settings.page_size)
 
-    gen = _generator(settings, default="poisson")
-    if settings.prompt_file:
-        pairs = _load_requests(settings, max_prompt_len, vocab)
-        offsets = gen.schedule(len(pairs))
-        reqs = [(float(offsets[i]), p, n or settings.max_new_tokens)
-                for i, (p, n) in enumerate(pairs)]
-    else:
-        plen = min(settings.synthetic_prompt_len or max_prompt_len,
-                   max_prompt_len)
-        reqs = [(r.t, r.prompt, r.max_new_tokens)
-                for r in gen.requests(
-                    settings.synthetic_requests, vocab_size=vocab,
-                    prompt_len=plen,
-                    max_new_tokens=settings.max_new_tokens,
-                    shared_prefix_len=min(settings.shared_prefix_len,
-                                          plen))]
+    scaler = None
+    if settings.autoscale:
+        from ..obs import trace as trace_lib
+        from ..serving.autoscale import AutoScaler
+        amax = settings.autoscale_max or settings.replicas
+        scaler = AutoScaler(
+            fleet, router,
+            min_replicas=settings.autoscale_min,
+            max_replicas=max(amax, settings.autoscale_min),
+            slo_ttft_s=settings.autoscale_slo_ttft_s,
+            up_backlog=settings.autoscale_up_backlog,
+            down_frac=settings.autoscale_down_frac,
+            cooldown_s=settings.autoscale_cooldown_s,
+            window_s=settings.autoscale_window_s,
+            drain_timeout_s=settings.drain_timeout_s,
+            tracer=trace_lib.tracer_for(
+                fleet_dir, "autoscaler",
+                armed=True if settings.trace else None,
+                proc="autoscaler"))
+
     print(f"# fleet: {settings.replicas} replicas, {len(reqs)} requests, "
           f"traffic {gen.describe()}", file=sys.stderr, flush=True)
 
@@ -897,6 +987,8 @@ def _fleet_main(settings: ServeSettings) -> dict:
                 except (FileNotFoundError, RuntimeError) as e:
                     swap_report = {"ok": False,
                                    "error": f"arm failed: {e}"}
+            if scaler is not None:
+                scaler.step()
             if (next_idx >= len(reqs) and router.all_done()
                     and not fleet.swap_active):
                 break
@@ -905,6 +997,14 @@ def _fleet_main(settings: ServeSettings) -> dict:
                 break
             time.sleep(0.01)
     finally:
+        if scaler is not None:
+            scaler.close()
+            scaler.tracer.close()
+        for c in router.clients.values():
+            try:
+                c.close()
+            except OSError:
+                pass
         rcs = fleet.stop()
         decode_rcs = decode_fleet.stop() if decode_fleet else None
     wall_s = time.perf_counter() - t0
@@ -923,6 +1023,15 @@ def _fleet_main(settings: ServeSettings) -> dict:
     tokens = sum(len(r.tokens) for r in records if r.state == "done")
     agg = goodput.aggregate_serving(fleet_dir)
     dropped = router.submitted - router.completed
+
+    # fleet-wide prefix-cache economics: sum the per-attempt sidecar
+    # counters (each clean worker exit books its engine's totals)
+    prefix_hits = prefix_misses = 0
+    for rdir in goodput.list_replica_dirs(fleet_dir):
+        for rec in goodput.read_serving_records(rdir).values():
+            prefix_hits += int(rec.get("prefix_hits") or 0)
+            prefix_misses += int(rec.get("prefix_misses") or 0)
+
     result = {
         "mode": "fleet",
         "replicas": settings.replicas,
@@ -941,6 +1050,14 @@ def _fleet_main(settings: ServeSettings) -> dict:
         "swap": swap_report,
         "replica_rcs": rcs,
         "wall_s": round(wall_s, 2),
+        "transport": settings.serve_transport,
+        "affinity_placements": router.affinity_placements,
+        "affinity_hits": router.affinity_hits,
+        "prefix_hits": prefix_hits,
+        "prefix_misses": prefix_misses,
+        "prefix_hit_rate": round(
+            prefix_hits / max(1, prefix_hits + prefix_misses), 4),
+        "autoscale": scaler.summary() if scaler is not None else None,
         "serving_goodput": {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in agg.items() if k != "per_replica"},
@@ -958,6 +1075,10 @@ def _fleet_main(settings: ServeSettings) -> dict:
 
 def main(ns: argparse.Namespace) -> dict:
     settings = ServeSettings.from_argparse(ns)
+    # orbax refuses relative checkpoint paths, and fleet worker argv must
+    # survive whatever cwd the replica subprocess starts in — normalize
+    # once here so every downstream consumer sees an absolute path
+    settings.checkpoint_path = os.path.abspath(settings.checkpoint_path)
     if settings.fleet_worker_dir:
         if settings.disagg_role == "prefill":
             return _disagg_prefill_main(settings)

@@ -205,6 +205,10 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
         # its own run dir — ISSUE 15 satellite): decode-phase MFU live
         led = ledger_lib.read_ledger(rd)
         dec = (led or {}).get("programs", {}).get("serve_decode") or {}
+        ph, pm = b.get("prefix_hits"), b.get("prefix_misses")
+        hit_rate = None
+        if isinstance(ph, int) and isinstance(pm, int) and ph + pm > 0:
+            hit_rate = round(ph / (ph + pm), 4)
         rows.append({
             "replica": rid,
             "state": state,
@@ -216,6 +220,7 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
             "serving_s": snap.get("serving_s"),
             "drain_s": snap.get("drain_s"),
             "swap_s": snap.get("swap_s"),
+            "prefix_hit_rate": hit_rate,
             "mfu": (round(float(dec["mfu"]), 4)
                     if isinstance(dec.get("mfu"), (int, float))
                     else None),
@@ -266,7 +271,8 @@ def render(snap: dict) -> str:
     if snap["kind"] == "fleet":
         headers = ["replica", "state", "attempt", "params_step", "tick",
                    "beacon_age_s", "in_flight", "serving_s", "drain_s",
-                   "swap_s", "mfu", "tokens_per_s", "attempts"]
+                   "swap_s", "prefix_hit_rate", "mfu", "tokens_per_s",
+                   "attempts"]
         out.append(_table(headers, [[r.get(h) for h in headers]
                                     for r in snap["replicas"]]))
         out.append(

@@ -8,12 +8,15 @@ backoff, crash-loop fail-fast, and the r12 beacon-mtime hang watchdog
 (a replica that wedges mid-request stops beaconing and gets SIGKILLed,
 which the router then treats like any other death: replay on a sibling).
 
-The replica transport is FILES inside the fleet dir — deliberately: a
-request that only ever lived in a socket buffer dies with the process,
-while the router's append-only journal plus per-replica inbox/outbox
-survive any kill and make replay a pure bookkeeping operation. Layout
-(dir names owned by :mod:`..chaos.goodput` so import-light readers
-agree)::
+The replica transport lives behind the :mod:`.transport` contract: the
+tier-1 default is FILES inside the fleet dir — deliberately: a request
+that only ever lived in a socket buffer dies with the process, while the
+router's append-only journal plus per-replica inbox/outbox survive any
+kill and make replay a pure bookkeeping operation. The alternative
+``socket`` transport moves only the DATA plane (submit/drain/heartbeat)
+onto TCP so replicas can live on other hosts; the ctrl plane below stays
+file-based either way. Layout (dir names owned by
+:mod:`..chaos.goodput` so import-light readers agree)::
 
     fleet_dir/
       journal.jsonl            router's durable request journal
@@ -59,7 +62,6 @@ from __future__ import annotations
 
 import contextlib
 import glob
-import json
 import os
 import threading
 import time
@@ -68,33 +70,25 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..chaos import goodput as goodput_lib
 from ..chaos.inject import COMMIT_MARKERS
 from ..obs import trace as trace_lib
+from .transport import (  # noqa: F401  (re-exported: pre-r17 import site)
+    FileReplicaClient,
+    ReplicaClient,
+    ReplicaPaths,
+    SocketReplicaClient,
+    WorkerSocketEndpoint,
+    read_json_file,
+    write_json_atomic,
+)
 
 __all__ = [
-    "ReplicaPaths", "ReplicaClient", "WorkerProtocol", "ServingTracker",
+    "ReplicaPaths", "ReplicaClient", "FileReplicaClient",
+    "SocketReplicaClient", "WorkerProtocol", "ServingTracker",
     "ServingFleet", "write_json_atomic", "read_json_file",
     "find_newest_finalized",
 ]
 
 
-# --------------------------------------------------------------- file layer
-
-def write_json_atomic(path: str, payload: dict) -> None:
-    """tmp-write + rename: a reader never sees a torn JSON file, and a
-    writer killed mid-write leaves only a ``.tmp`` corpse behind."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(json.dumps(payload))
-    os.replace(tmp, path)
-
-
-def read_json_file(path: str) -> Optional[dict]:
-    try:
-        with open(path) as f:
-            payload = json.load(f)
-    except (OSError, ValueError):
-        return None
-    return payload if isinstance(payload, dict) else None
-
+# ---------------------------------------------------- checkpoint discovery
 
 def find_newest_finalized(directory: str) -> Optional[str]:
     """Newest ``model_*`` checkpoint dir carrying a commit marker — the
@@ -119,42 +113,6 @@ def find_newest_finalized(directory: str) -> Optional[str]:
         if int(digits) > best_step:
             best_step, best = int(digits), path
     return best
-
-
-class ReplicaPaths:
-    """Canonical file locations for one replica (root doubles as the
-    launcher run dir, so beacons/attempts land next to the mailboxes)."""
-
-    def __init__(self, fleet_dir: str, rid: int,
-                 root: Optional[str] = None) -> None:
-        self.rid = rid
-        self.root = root or goodput_lib.replica_dir(fleet_dir, rid)
-        self.inbox = os.path.join(self.root, "inbox")
-        self.outbox = os.path.join(self.root, "outbox")
-        self.ctrl = os.path.join(self.root, "ctrl")
-        self.log_dir = os.path.join(self.root, "logs")
-        self.ready_path = os.path.join(self.ctrl, "ready.json")
-        self.stop_path = os.path.join(self.ctrl, "stop")
-        self.swap_path = os.path.join(self.ctrl, "swap.json")
-        self.swap_ack_path = os.path.join(self.ctrl, "swap_ack.json")
-        self.current_path = os.path.join(self.ctrl, "current.json")
-
-    @classmethod
-    def at(cls, root: str, rid: int = 0) -> "ReplicaPaths":
-        """Build from an existing replica root (the worker side only
-        knows its own ``--fleet_worker_dir``, not the fleet dir)."""
-        return cls("", rid, root=root)
-
-    def ensure(self) -> "ReplicaPaths":
-        for d in (self.root, self.inbox, self.outbox, self.ctrl):
-            os.makedirs(d, exist_ok=True)
-        return self
-
-    def req_path(self, req_id: int) -> str:
-        return os.path.join(self.inbox, f"req_{req_id:08d}.json")
-
-    def result_path(self, req_id: int) -> str:
-        return os.path.join(self.outbox, f"req_{req_id:08d}.json")
 
 
 # ------------------------------------------------------------ worker side
@@ -215,9 +173,15 @@ class WorkerProtocol:
 
     def __init__(self, paths: ReplicaPaths, replica_id: int,
                  attempt: Optional[int] = None,
-                 trace_armed: Optional[bool] = None) -> None:
+                 trace_armed: Optional[bool] = None,
+                 transport: str = "file") -> None:
+        if transport not in ("file", "socket"):
+            raise ValueError(f"unknown replica transport {transport!r}")
         self.paths = paths.ensure()
         self.replica_id = replica_id
+        self.transport = transport
+        self._endpoint: Optional[WorkerSocketEndpoint] = None
+        self._socket_pending: Dict[int, dict] = {}  # admitted, unconsumed
         self.attempt = (attempt if attempt is not None
                         else int(os.environ.get("DPT_ATTEMPT") or 0))
         self.tracker = ServingTracker()
@@ -260,6 +224,12 @@ class WorkerProtocol:
                 os.unlink(path)
             except OSError:
                 pass
+        if self.transport == "socket":
+            # the data plane comes up here, AFTER the stale-inbox purge
+            # and before any ready announcement: a router that connects
+            # early just sees an empty drain
+            self._endpoint = WorkerSocketEndpoint(
+                self.paths, self.replica_id, self.attempt)
         return read_json_file(self.paths.current_path)
 
     def announce_ready(self, params_step: int) -> None:
@@ -279,24 +249,37 @@ class WorkerProtocol:
         return os.path.exists(self.paths.stop_path)
 
     def poll_inbox(self) -> List[dict]:
-        """Pending requests, oldest id first. Files are NOT consumed here
-        — call :meth:`consume` once the request is safely admitted, so a
-        kill between read and admit leaves the file for the replay path."""
+        """Pending requests, oldest id first. Entries are NOT consumed
+        here — call :meth:`consume` once the request is safely admitted,
+        so a kill between read and admit leaves the entry for the replay
+        path (for the socket transport the entry lives only in this
+        attempt's memory; the attempt bump replays it all the same)."""
         out = []
-        for path in sorted(glob.glob(
-                os.path.join(self.paths.inbox, "req_*.json"))):
-            payload = read_json_file(path)
-            if payload is not None:
-                out.append(payload)
-                if self.tracer.enabled:
-                    # first sight of the request on this replica: the
-                    # serve span (booked at write_result) starts here
-                    self._admits.setdefault(
-                        int(payload.get("id", -1)),
-                        (payload.get("trace"), time.time()))
+        if self.transport == "socket":
+            assert self._endpoint is not None
+            for payload in self._endpoint.take_submits():
+                self._socket_pending[int(payload.get("id", -1))] = payload
+            out = [self._socket_pending[k]
+                   for k in sorted(self._socket_pending)]
+        else:
+            for path in sorted(glob.glob(
+                    os.path.join(self.paths.inbox, "req_*.json"))):
+                payload = read_json_file(path)
+                if payload is not None:
+                    out.append(payload)
+        if self.tracer.enabled:
+            for payload in out:
+                # first sight of the request on this replica: the
+                # serve span (booked at write_result) starts here
+                self._admits.setdefault(
+                    int(payload.get("id", -1)),
+                    (payload.get("trace"), time.time()))
         return out
 
     def consume(self, req_id: int) -> None:
+        if self.transport == "socket":
+            self._socket_pending.pop(req_id, None)
+            return
         try:
             os.unlink(self.paths.req_path(req_id))
         except OSError:
@@ -305,8 +288,12 @@ class WorkerProtocol:
     def write_result(self, payload: dict) -> None:
         payload = {**payload, "replica": self.replica_id,
                    "attempt": self.attempt, "t_done": time.time()}
-        write_json_atomic(self.paths.result_path(int(payload["id"])),
-                          payload)
+        if self.transport == "socket":
+            assert self._endpoint is not None
+            self._endpoint.queue_result(payload)
+        else:
+            write_json_atomic(self.paths.result_path(int(payload["id"])),
+                              payload)
         admit = self._admits.pop(int(payload["id"]), None)
         if admit is not None and self.tracer.enabled:
             trace_id, t_admit = admit
@@ -352,11 +339,24 @@ class WorkerProtocol:
         }
         if extra:
             payload.update(extra)
+        if self._endpoint is not None:
+            # the SAME tick that proves loop liveness to the file
+            # watchdog refreshes the heartbeat stamp (and the advertised
+            # prefix index) — the two liveness signals cannot drift
+            hb_extra = None
+            if extra and "prefix_index" in extra:
+                hb_extra = {"prefix_index": extra["prefix_index"]}
+            self._endpoint.tick(payload["t"], extra=hb_extra)
         path = goodput_lib.beacon_path(self.paths.root, 0)
         try:
             write_json_atomic(path, payload)
         except OSError:
             pass  # telemetry: never fail a tick
+
+    def close(self) -> None:
+        if self._endpoint is not None:
+            self._endpoint.close()
+            self._endpoint = None
 
     def write_sidecar(self, extra: Optional[dict] = None) -> None:
         """Clean-exit serving record (aggregate_serving prefers it over
@@ -370,53 +370,6 @@ class WorkerProtocol:
                 self.paths.root, self.attempt), payload)
         except OSError:
             pass
-
-
-# ------------------------------------------------------------ router side
-
-class ReplicaClient:
-    """Router-side view of one replica: submit into its inbox, consume
-    its outbox, read its liveness (ready epoch + beacon age + supervisor
-    thread)."""
-
-    def __init__(self, paths: ReplicaPaths,
-                 alive_fn: Callable[[], bool] = lambda: True) -> None:
-        self.paths = paths.ensure()
-        self.rid = paths.rid
-        self._alive_fn = alive_fn
-
-    def alive(self) -> bool:
-        """Whether anything still supervises this replica (a dead
-        supervisor means no more restarts: the replica is gone for good)."""
-        return bool(self._alive_fn())
-
-    def ready(self) -> Optional[dict]:
-        return read_json_file(self.paths.ready_path)
-
-    def beacon_age_s(self, now: Optional[float] = None) -> Optional[float]:
-        mtimes = goodput_lib.beacon_mtimes(self.paths.root)
-        if not mtimes:
-            return None
-        return max(0.0, (now if now is not None else time.time())
-                   - max(mtimes.values()))
-
-    def submit(self, payload: dict) -> None:
-        write_json_atomic(self.paths.req_path(int(payload["id"])), payload)
-
-    def consume_results(self) -> List[dict]:
-        out = []
-        for path in sorted(glob.glob(
-                os.path.join(self.paths.outbox, "req_*.json"))):
-            payload = read_json_file(path)
-            if payload is None:
-                continue  # torn writes impossible (atomic rename); a
-                # vanished file was consumed by a competing reader
-            out.append(payload)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-        return out
 
 
 # ------------------------------------------------------------- supervisor
@@ -443,9 +396,12 @@ class ServingFleet:
                  restart_backoff_max_s: float = 5.0,
                  monitor_interval: float = 0.05,
                  replica_platform: str = "cpu",
+                 transport: str = "file",
                  launch_fn: Optional[Callable[..., int]] = None) -> None:
         if n_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {n_replicas}")
+        if transport not in ("file", "socket"):
+            raise ValueError(f"unknown replica transport {transport!r}")
         self.fleet_dir = os.path.abspath(fleet_dir)
         self.n_replicas = n_replicas
         self.worker_modname = worker_modname
@@ -464,6 +420,7 @@ class ServingFleet:
         self.restart_backoff_s = restart_backoff_s
         self.restart_backoff_max_s = restart_backoff_max_s
         self.monitor_interval = monitor_interval
+        self.transport = transport
         self._launch_fn = launch_fn
         self.paths = [ReplicaPaths(self.fleet_dir, i).ensure()
                       for i in range(n_replicas)]
@@ -479,32 +436,59 @@ class ServingFleet:
         from ..parallel.launcher import run_argv_as_distributed
         return run_argv_as_distributed
 
+    def _supervise(self, i: int) -> None:
+        argv = self.worker_argv + [
+            "--fleet_worker_dir", self.paths[i].root,
+            "--replica_id", str(i)]
+        self._rcs[i] = self._launch()(
+            self.worker_modname, argv, nprocs=1,
+            devices_per_proc=self.devices_per_proc,
+            max_restarts=self.max_restarts,
+            monitor_interval=self.monitor_interval,
+            log_dir=self.paths[i].log_dir,
+            restart_backoff_s=self.restart_backoff_s,
+            restart_backoff_max_s=self.restart_backoff_max_s,
+            hang_timeout_s=self.hang_timeout_s,
+            hang_startup_timeout_s=self.hang_startup_timeout_s,
+            extra_env={"DPT_REPLICA": str(i)},
+            tag=f"replica{i}",
+            worker_platform=self.replica_platform)
+
+    def _spawn(self, i: int) -> None:
+        t = threading.Thread(target=self._supervise, args=(i,),
+                             name=f"fleet-replica-{i}", daemon=True)
+        self._threads[i] = t
+        t.start()
+
     def start(self) -> None:
-        launch = self._launch()
-
-        def _supervise(i: int) -> None:
-            argv = self.worker_argv + [
-                "--fleet_worker_dir", self.paths[i].root,
-                "--replica_id", str(i)]
-            self._rcs[i] = launch(
-                self.worker_modname, argv, nprocs=1,
-                devices_per_proc=self.devices_per_proc,
-                max_restarts=self.max_restarts,
-                monitor_interval=self.monitor_interval,
-                log_dir=self.paths[i].log_dir,
-                restart_backoff_s=self.restart_backoff_s,
-                restart_backoff_max_s=self.restart_backoff_max_s,
-                hang_timeout_s=self.hang_timeout_s,
-                hang_startup_timeout_s=self.hang_startup_timeout_s,
-                extra_env={"DPT_REPLICA": str(i)},
-                tag=f"replica{i}",
-                worker_platform=self.replica_platform)
-
         for i in range(self.n_replicas):
-            t = threading.Thread(target=_supervise, args=(i,),
-                                 name=f"fleet-replica-{i}", daemon=True)
-            self._threads[i] = t
-            t.start()
+            self._spawn(i)
+
+    def add_replica(self) -> int:
+        """Elastic scale-up: append a new supervised replica ring and
+        return its rid. The warmup-before-ready contract means the new
+        replica takes no traffic until its ``ready.json`` lands — the
+        autoscaler gets warm capacity for free. rids are never re-used
+        (a scaled-down slot keeps its dir for the goodput fold), so a
+        fresh replica can never inherit a dead attempt's ctrl state."""
+        rid = self.n_replicas
+        p = ReplicaPaths(self.fleet_dir, rid).ensure()
+        self.paths.append(p)
+        self._threads.append(None)
+        self._rcs.append(None)
+        self.n_replicas += 1
+        self._spawn(rid)
+        return rid
+
+    def stop_replica(self, rid: int) -> None:
+        """Graceful per-replica stop (scale-down): the stop flag makes
+        the worker drain and exit 0, ending its supervising ring. Call
+        only after the router has drained placement off the replica."""
+        try:
+            with open(self.paths[rid].stop_path, "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            pass
 
     def alive(self, rid: int) -> bool:
         t = self._threads[rid]
@@ -513,10 +497,14 @@ class ServingFleet:
     def rc(self, rid: int) -> Optional[int]:
         return self._rcs[rid]
 
+    def client(self, rid: int) -> ReplicaClient:
+        alive_fn = (lambda rid=rid: self.alive(rid))
+        if self.transport == "socket":
+            return SocketReplicaClient(self.paths[rid], alive_fn=alive_fn)
+        return FileReplicaClient(self.paths[rid], alive_fn=alive_fn)
+
     def clients(self) -> Dict[int, ReplicaClient]:
-        return {i: ReplicaClient(self.paths[i],
-                                 alive_fn=(lambda i=i: self.alive(i)))
-                for i in range(self.n_replicas)}
+        return {i: self.client(i) for i in range(self.n_replicas)}
 
     def stop(self, join_timeout_s: float = 30.0) -> List[Optional[int]]:
         """Graceful shutdown: stop flags make workers drain and exit 0,

@@ -170,9 +170,14 @@ class PrefixCache:
     * ``entry refs`` — how many cache entries contain the page. A page is
       returned to the :class:`PageManager` only when BOTH hit zero
       (release frees private pages immediately; shared pages persist in
-      the cache — that is the feature — until :meth:`evict` drops their
-      entries under pool pressure, LRU-first, skipping entries any live
-      slot still references).
+      the cache — that is the feature — until eviction drops their
+      entries under pool pressure, LRU-first). Eviction never frees a
+      page a live slot still reads: dropping the entry merely orphans
+      it, and :meth:`release` frees it with the last slot ref. Entries
+      are always droppable — eviction that waited for slot refs to
+      clear would deadlock admission on shared-prefix workloads, where
+      every entry's head pages are pinned by the very request being
+      admitted.
 
     Entries are keyed by the raw bytes of the page-aligned token prefix,
     one entry per full-page depth, so nested prefixes share page ids and
@@ -261,40 +266,61 @@ class PrefixCache:
 
     def release(self, prompt: np.ndarray, pages: np.ndarray) -> np.ndarray:
         """A slot finished (completion OR replay-abandonment): drop its
-        slot refs on the prefix pages and return the PRIVATE tail pages —
-        the only ones safe to free now. Shared pages stay resident in the
-        cache for the next sharer."""
+        slot refs on the prefix pages and return the pages now safe to
+        free — the PRIVATE tail, plus any prefix page ORPHANED by an
+        eviction that ran while this slot still read it (entry refs
+        already zero; this was its last slot ref). Shared pages still in
+        the cache stay resident for the next sharer."""
         ids = [int(p) for p in np.asarray(pages).ravel()]
         k = min(self._full_pages(len(prompt)), len(ids))
+        freeable = ids[k:]
         for p in ids[:k]:
             if self._slot_refs[p] > 0:
                 self._slot_refs[p] -= 1
             if self._slot_refs[p] == 0:
                 del self._slot_refs[p]
-        return np.asarray(ids[k:], np.int32)
+                if self._entry_refs.get(p, 0) == 0:
+                    freeable.append(p)
+        return np.asarray(freeable, np.int32)
 
     # --------------------------------------------------------------- evict
 
     def _evict_one(self) -> bool:
-        """Drop the least-recently-used entry whose pages no live slot
-        references; free pages that leave their last entry. Returns
-        whether anything was evicted."""
-        for key in list(self._entries):
-            entry = self._entries[key]
-            if any(self._slot_refs.get(p, 0) > 0 for p in entry):
-                continue  # a live slot still reads these pages
-            del self._entries[key]
+        """Drop one cache entry, LRU-first, and free every page that
+        leaves BOTH its last entry and its last slot ref. Pages a live
+        slot still reads are never freed here — dropping the entry only
+        orphans them, and :meth:`release` frees them when the last slot
+        ref goes. Prefers the oldest entry whose eviction frees a page
+        RIGHT NOW; with nothing immediately freeable it still drops the
+        LRU head (progress under pool pressure must not depend on the
+        eviction freeing synchronously — a shared-prefix workload keeps
+        slot refs on every entry's head pages, and skipping all of them
+        deadlocked admission: nothing evictable, pool exhausted, the
+        scheduler's head-of-line wait spinning forever). Returns whether
+        an entry was dropped."""
+        def drop(key: bytes) -> None:
+            entry = self._entries.pop(key)
             self.evicted_entries += 1
             freed = []
             for p in entry:
                 self._entry_refs[p] -= 1
                 if self._entry_refs[p] == 0:
                     del self._entry_refs[p]
-                    freed.append(p)
+                    if self._slot_refs.get(p, 0) == 0:
+                        freed.append(p)
             if freed:
                 self.mgr.free(np.asarray(freed, np.int32))
-            return True
-        return False
+
+        if not self._entries:
+            return False
+        for key in list(self._entries):
+            entry = self._entries[key]
+            if any(self._entry_refs[p] == 1
+                   and self._slot_refs.get(p, 0) == 0 for p in entry):
+                drop(key)
+                return True
+        drop(next(iter(self._entries)))
+        return True
 
     def evict_for(self, n_pages: int) -> int:
         """Free cache-resident pages until the pool can cover ``n_pages``

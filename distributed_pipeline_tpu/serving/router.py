@@ -24,11 +24,23 @@ least-loaded replica:
 * RECOVERY — :meth:`Router.recover` rebuilds pending/done state from the
   journal alone, so even a router restart (the supervisor process dying)
   loses no admitted request.
+* AFFINITY (opt-in) — with ``affinity=True`` the router hashes each
+  prompt's page-aligned prefix blocks at submit and, among HEALTHY
+  candidates, prefers the replica whose advertised prefix-cache index
+  (``prefix_index`` riding beacons/heartbeats) matches the most leading
+  blocks — multiplying per-replica prefix caches into a fleet-wide
+  cache. Ties and cold prefixes fall back to least-loaded, and affinity
+  NEVER overrides the health gate, so replay semantics are unchanged: a
+  replayed request simply re-scores against the surviving replicas (its
+  cached prefix died with the replica — the replay is correct, just
+  cold).
 
 Import-light (numpy + stdlib): runs in the jax-free fleet process. The
-replica transport is duck-typed (``fleet.ReplicaClient`` or any object
-with ``alive/ready/beacon_age_s/submit/consume_results``), so tests drive
-the router with in-memory fakes.
+replica transport is duck-typed (``transport.ReplicaClient`` or any
+object with ``alive/ready/beacon_age_s/submit/consume_results``), so
+tests drive the router with in-memory fakes. ``submit`` on a client may
+raise (a socket transport mid-outage): the placement is reverted and the
+request stays pending — nothing is stranded on an unreachable wire.
 """
 
 from __future__ import annotations
@@ -37,11 +49,12 @@ import collections
 import dataclasses
 import json
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs.trace import request_trace_id
+from .transport import prefix_block_hashes
 
 __all__ = ["RoutedRequest", "Router"]
 
@@ -71,6 +84,8 @@ class RoutedRequest:
     ttft_s: Optional[float] = None
     params_step: Optional[int] = None
     done_t: float = 0.0
+    prefix: Tuple[int, ...] = ()        # page-aligned prefix block hashes
+    #                                     (empty unless affinity routing)
 
 
 class Router:
@@ -79,10 +94,13 @@ class Router:
     ``submit`` only enqueues + journals."""
 
     def __init__(self, clients: Dict[int, object], journal_path: str, *,
-                 stale_beacon_s: float = 10.0) -> None:
+                 stale_beacon_s: float = 10.0,
+                 affinity: bool = False, page_size: int = 16) -> None:
         self.clients = dict(clients)
         self.journal_path = journal_path
         self.stale_beacon_s = stale_beacon_s
+        self.affinity = bool(affinity)
+        self.page_size = int(page_size)
         self.records: Dict[int, RoutedRequest] = {}
         self.queue: Deque[int] = collections.deque()
         self._epochs: Dict[int, Optional[int]] = {
@@ -92,6 +110,9 @@ class Router:
         self._req_counter = 0
         self.replayed = 0
         self.duplicate_results = 0
+        self.affinity_hits = 0        # placements won by a warm prefix
+        self.affinity_placements = 0  # placements scored (affinity on,
+        #                               request had >= 1 full block)
 
     # -------------------------------------------------------------- journal
 
@@ -113,7 +134,9 @@ class Router:
             max_new_tokens=int(max_new_tokens),
             submit_t=float(submit_t if submit_t is not None
                            else time.time()),
-            trace_id=request_trace_id(self._req_counter))
+            trace_id=request_trace_id(self._req_counter),
+            prefix=(prefix_block_hashes(prompt, self.page_size)
+                    if self.affinity else ()))
         self.records[rec.id] = rec
         self.queue.append(rec.id)
         # the full prompt rides the journal: recovery must be able to
@@ -123,6 +146,24 @@ class Router:
                        "prompt": prompt.tolist(),
                        "max_new_tokens": rec.max_new_tokens})
         return rec
+
+    # -------------------------------------------------------- elastic fleet
+
+    def add_client(self, rid: int, client: object) -> None:
+        """Scale-up: admit a new replica into placement. It takes no
+        traffic until its ready.json lands (the normal health gate)."""
+        self.clients[rid] = client
+        self._epochs.setdefault(rid, None)
+        self._down.discard(rid)
+        self._draining.discard(rid)
+
+    def retire(self, rid: int) -> None:
+        """Scale-down terminal state: the replica was DRAINED first (set
+        ``set_draining`` and wait for ``outstanding == 0``), so unlike a
+        death there is nothing to replay — mark it permanently down so
+        neither placement nor the down-detection path touches it again."""
+        self._draining.discard(rid)
+        self._down.add(rid)
 
     # --------------------------------------------------------------- health
 
@@ -224,28 +265,68 @@ class Router:
         for rid in self.clients:
             if rid not in self._down:
                 self._consume(rid)
-        # placement: least-loaded healthy replica per pending request
+        # placement: affinity-scored (if enabled), else least-loaded,
+        # healthy replica per pending request
         while self.queue:
             candidates = [rid for rid in self.clients
                           if self.healthy(rid, now)]
             if not candidates:
                 break
-            rid = min(candidates, key=lambda r: (self.outstanding(r), r))
             rec = self.records[self.queue.popleft()]
             if rec.state != "pending":
                 continue  # stale queue entry (already replayed + done)
+            score = 0
+            if self.affinity and rec.prefix:
+                scores = {r: self._affinity_score(r, rec.prefix)
+                          for r in candidates}
+                score = max(scores.values())
+                self.affinity_placements += 1
+                if score > 0:
+                    self.affinity_hits += 1
+                    candidates = [r for r in candidates
+                                  if scores[r] == score]
+            rid = min(candidates, key=lambda r: (self.outstanding(r), r))
             rec.state = "assigned"
             rec.replica = rid
             rec.epoch = self._epochs[rid]
             rec.assign_t = now
-            self.clients[rid].submit({
-                "id": rec.id, "prompt": rec.prompt.tolist(),
-                "max_new_tokens": rec.max_new_tokens,
-                "submit_t": rec.submit_t, "replays": rec.replays,
-                "trace": rec.trace_id})
+            try:
+                self.clients[rid].submit({
+                    "id": rec.id, "prompt": rec.prompt.tolist(),
+                    "max_new_tokens": rec.max_new_tokens,
+                    "submit_t": rec.submit_t, "replays": rec.replays,
+                    "trace": rec.trace_id})
+            except (OSError, ConnectionError):
+                # data-plane outage (socket mid-fault): nothing reached
+                # the replica, so revert — the request stays pending and
+                # the replica's growing heartbeat age will gate it out
+                rec.state = "pending"
+                rec.replica = None
+                rec.epoch = None
+                self.queue.appendleft(rec.id)
+                break
             self._journal({"ev": "assign", "id": rec.id, "replica": rid,
                            "epoch": rec.epoch, "trace": rec.trace_id,
-                           "t": now})
+                           "t": now, "affinity": score})
+
+    def _affinity_score(self, rid: int, prefix: tuple) -> int:
+        """Number of the request's LEADING prefix blocks the replica
+        advertises — the count of cache pages a hit would skip. Clients
+        without an index (old transports, in-memory fakes) score 0 and
+        simply fall back to least-loaded."""
+        index = getattr(self.clients[rid], "prefix_index", None)
+        if index is None:
+            return 0
+        try:
+            advertised = set(index() or ())
+        except (OSError, ConnectionError):
+            return 0
+        score = 0
+        for h in prefix:
+            if h not in advertised:
+                break
+            score += 1
+        return score
 
     # ---------------------------------------------------------------- stats
 
@@ -261,12 +342,29 @@ class Router:
     def in_flight(self) -> int:
         return sum(1 for r in self.records.values() if r.state != "done")
 
+    @property
+    def backlog(self) -> int:
+        """Pending requests not yet placed anywhere (the autoscaler's
+        pressure signal)."""
+        return sum(1 for r in self.records.values()
+                   if r.state == "pending")
+
     def all_done(self) -> bool:
         return self.in_flight == 0
 
     def ttfts(self) -> List[float]:
         return [r.ttft_s for r in self.records.values()
                 if r.state == "done" and r.ttft_s is not None]
+
+    def recent_ttfts(self, window_s: float,
+                     now: Optional[float] = None) -> List[float]:
+        """TTFTs of requests completed within the trailing window — the
+        autoscaler's live SLO signal (completions only: a request still
+        queued shows up as backlog, not as a fake-good TTFT)."""
+        now = time.time() if now is None else now
+        return [r.ttft_s for r in self.records.values()
+                if r.state == "done" and r.ttft_s is not None
+                and now - r.done_t <= window_s]
 
     # ------------------------------------------------------------- recovery
 

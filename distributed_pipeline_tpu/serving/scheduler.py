@@ -411,9 +411,15 @@ class DecodeServer:
             self.prefix.evict_for(need)
             fresh = self.mgr.alloc(need)
         if fresh is None:
-            if shared:  # roll the acquire back: drop the slot refs only
-                self.prefix.release(req.prompt[:covered],
-                                    np.asarray(shared, np.int32))
+            if shared:
+                # roll the acquire back. If the evict_for above dropped
+                # the shared pages' entries, ours were the last refs and
+                # release hands the orphans back — free them, or the
+                # pool shrinks a page per failed admission
+                back = self.prefix.release(req.prompt[:covered],
+                                           np.asarray(shared, np.int32))
+                if back.size:
+                    self.mgr.free(back)
             return None
         pages = np.concatenate(
             [np.asarray(shared, np.int32), fresh]) if shared else fresh

@@ -21,10 +21,12 @@ worker.
 
 Argv: --fleet_worker_dir DIR --replica_id I --checkpoint_dir CKPTS
       [--step N] [--token_interval_s S] [--startup_s S]
-      [--cost_ledger true|false]
+      [--cost_ledger true|false] [--serve_transport file|socket]
+      [--prefix_cache true|false]
 """
 
 import argparse
+import collections
 import json
 import os
 import time
@@ -37,6 +39,9 @@ parser.add_argument("--step", type=int, default=1)
 parser.add_argument("--token_interval_s", type=float, default=0.003)
 parser.add_argument("--startup_s", type=float, default=0.0)
 parser.add_argument("--cost_ledger", default="false")
+parser.add_argument("--serve_transport", default="file")
+parser.add_argument("--prefix_cache", default="false")
+parser.add_argument("--page_size", type=int, default=4)
 ns = parser.parse_args()
 
 from distributed_pipeline_tpu.chaos import (  # noqa: E402
@@ -48,9 +53,13 @@ from distributed_pipeline_tpu.serving.fleet import (  # noqa: E402
     ReplicaPaths,
     WorkerProtocol,
 )
+from distributed_pipeline_tpu.serving.transport import (  # noqa: E402
+    prefix_block_hashes,
+)
 
 paths = ReplicaPaths.at(ns.fleet_worker_dir, ns.replica_id)
-proto = WorkerProtocol(paths, ns.replica_id)
+proto = WorkerProtocol(paths, ns.replica_id,
+                       transport=ns.serve_transport)
 pin = proto.startup()
 if ns.startup_s > 0:
     time.sleep(ns.startup_s)
@@ -76,6 +85,39 @@ completed = 0
 tokens_out = 0
 in_flight = {}  # id -> [payload, tokens]
 t_serve0 = time.time()
+
+# Simulated prefix cache (mirrors the real worker's advertisement):
+# leading blocks already served here count as hits; every admitted
+# block lands in a bounded LRU that rides the beacon/heartbeat.
+prefix_on = ns.prefix_cache.strip().lower() in ("true", "1", "yes")
+prefix_index: "collections.OrderedDict" = collections.OrderedDict()
+prefix_hits = 0
+prefix_misses = 0
+
+
+def index_prefix(prompt) -> None:
+    global prefix_hits, prefix_misses
+    if not prefix_on:
+        return
+    hashes = prefix_block_hashes([int(t) for t in prompt], ns.page_size)
+    leading = True
+    for h in hashes:
+        if leading and h in prefix_index:
+            prefix_hits += 1
+        else:
+            leading = False
+            prefix_misses += 1
+        prefix_index.pop(h, None)
+        prefix_index[h] = True
+        while len(prefix_index) > 256:
+            prefix_index.popitem(last=False)
+
+
+def beacon_extra():
+    if not prefix_on:
+        return None
+    return {"prefix_index": list(prefix_index),
+            "prefix_hits": prefix_hits, "prefix_misses": prefix_misses}
 
 
 def write_ledger():
@@ -161,11 +203,12 @@ while not proto.stop_requested():
     for payload in proto.poll_inbox():
         in_flight[int(payload["id"])] = [payload, []]
         proto.consume(int(payload["id"]))
+        index_prefix(payload["prompt"])
         admitted += 1
         moved = True
     moved = step_decode() or moved
     tick += 1
-    proto.write_beacon(tick)
+    proto.write_beacon(tick, extra=beacon_extra())
     if not moved:
         time.sleep(0.003)
 
@@ -177,6 +220,9 @@ with proto.tracker.timed("drain_s"):
 write_ledger()
 proto.write_sidecar({"ticks": tick, "admitted": admitted,
                      "completed": completed, "tokens": tokens_out,
-                     "params_step": cur_step})
+                     "params_step": cur_step,
+                     "prefix_hits": prefix_hits,
+                     "prefix_misses": prefix_misses})
 proto.tracer.close()
+proto.close()
 raise SystemExit(0)

@@ -1,0 +1,537 @@
+"""Autoscaler tests (ISSUE 17): SLO-driven scale decisions (backlog /
+p95-TTFT up, drain-first down, hysteresis, cooldown, min/max clamps),
+paid_idle accrual + its goodput re-booking (``accounted_frac`` stays 1.0),
+prefix-affinity placement units over the real Router, the deterministic
+fleet-workload contract (r13 NOTE), and an elastic e2e ring where live
+traffic grows and shrinks a real child-process fleet."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_pipeline_tpu.chaos import aggregate_serving, goodput
+from distributed_pipeline_tpu.config.serve import ServeSettings
+from distributed_pipeline_tpu.run.serve import fleet_workload
+from distributed_pipeline_tpu.serving.autoscale import AutoScaler
+from distributed_pipeline_tpu.serving.router import Router
+
+from tests.test_fleet import (
+    FakeReplica,
+    _drive,
+    _expected_tokens,
+    _fake_ckpt,
+    _start_fleet,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ============================================================ fakes / rigs
+
+class FakeFleet:
+    """Elastic-fleet stand-in: tracks add/stop calls, readiness is
+    instant (the real warmup gate is ServingFleet's, not the scaler's)."""
+
+    swap_active = False
+
+    def __init__(self, n):
+        self.n = n
+        self.added = []
+        self.stopped = []
+
+    def ready_replicas(self):
+        return [r for r in range(self.n) if r not in self.stopped]
+
+    def alive(self, rid):
+        return rid not in self.stopped
+
+    def add_replica(self):
+        rid = self.n
+        self.n += 1
+        self.added.append(rid)
+        return rid
+
+    def stop_replica(self, rid):
+        self.stopped.append(rid)
+
+    def client(self, rid):
+        return FakeReplica(rid)
+
+
+class FakeSignals:
+    """Router stand-in with directly scriptable signals."""
+
+    def __init__(self, rids, journal_path):
+        self.clients = {r: FakeReplica(r) for r in rids}
+        self.journal_path = journal_path
+        self.backlog = 0
+        self.ttfts = []
+        self._outstanding = {r: 0 for r in rids}
+        self._down = set()
+        self._draining = set()
+        self.retired = []
+
+    def down(self, rid):
+        return rid in self._down
+
+    def outstanding(self, rid):
+        return self._outstanding.get(rid, 0)
+
+    def recent_ttfts(self, window_s, now=None):
+        return list(self.ttfts)
+
+    def draining(self, rid):
+        return rid in self._draining
+
+    def set_draining(self, rid, flag):
+        (self._draining.add if flag else self._draining.discard)(rid)
+
+    def add_client(self, rid, client):
+        self.clients[rid] = client
+        self._outstanding.setdefault(rid, 0)
+
+    def retire(self, rid):
+        self._down.add(rid)
+        self.retired.append(rid)
+
+
+def _rig(tmp_path, n=2, **kw):
+    fleet = FakeFleet(n)
+    router = FakeSignals(range(n), str(tmp_path / "journal.jsonl"))
+    kw.setdefault("cooldown_s", 0.0)
+    scaler = AutoScaler(fleet, router, **kw)
+    return fleet, router, scaler
+
+
+def _journal_events(scaler):
+    try:
+        with open(scaler.journal_path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        return []
+
+
+# ======================================================== scale decisions
+
+def test_scale_up_on_backlog_and_journal(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=4,
+                                 up_backlog=2.0)
+    router.backlog = 5  # > 2.0 * 2 ready
+    scaler.step(now=100.0)
+    assert fleet.added == [2] and 2 in router.clients
+    assert scaler.scale_ups == 1
+    ev = _journal_events(scaler)
+    assert [e["ev"] for e in ev] == ["scale"]
+    assert ev[0]["dir"] == "up" and ev[0]["reason"] == "backlog"
+    assert ev[0]["replica"] == 2 and ev[0]["n_active"] == 3
+
+
+def test_scale_up_on_ttft_p95_breach(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 1, max_replicas=2,
+                                 slo_ttft_s=1.0)
+    router.ttfts = [0.2, 0.3, 5.0, 6.0, 7.0]  # p95 >> slo
+    scaler.step(now=100.0)
+    assert fleet.added == [1]
+    assert _journal_events(scaler)[0]["reason"] == "ttft_p95"
+
+
+def test_max_replicas_caps_growth(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=2)
+    router.backlog = 100
+    scaler.step()
+    assert fleet.added == [] and scaler.scale_ups == 0
+
+
+def test_crash_looping_fleet_does_not_grow_without_bound(tmp_path):
+    """A fleet whose replicas are DOWN but still supervised (restart
+    budget in hand) is hot — backlog grows, nothing completes — yet it
+    still owns max_replicas worth of capacity. Gating scale-up on
+    healthy-only replicas spawned a fresh ring every cooldown for as
+    long as the outage lasted (caught live: 13 scale-ups with
+    max_replicas=2); down-but-alive rings must count toward the cap."""
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=2)
+    router.backlog = 100
+    router._down = {0, 1}  # e.g. missed heartbeats while crash-looping
+    for _ in range(5):
+        scaler.step()
+    assert fleet.added == [] and scaler.scale_ups == 0
+
+
+def test_budget_exhausted_replica_frees_scale_up_headroom(tmp_path):
+    """The flip side: a replica that is down AND unsupervised (ring dead
+    — restart budget exhausted, or drained + retired) no longer counts,
+    so the scaler may place a replacement."""
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=2)
+    router.backlog = 100
+    router._down = {1}
+    fleet.stopped.append(1)  # ring is gone for good
+    scaler.step()
+    assert fleet.added == [2] and scaler.scale_ups == 1
+
+
+def test_cooldown_spaces_structural_changes(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 1, max_replicas=5,
+                                 cooldown_s=60.0)
+    router.backlog = 100
+    scaler.step()
+    scaler.step()
+    scaler.step()
+    assert fleet.added == [1], "cooldown must clamp to one change"
+
+
+def test_hysteresis_band_holds_steady(tmp_path):
+    """p95 between down_frac*slo and slo with no backlog: neither hot
+    nor cold — the band where bursty traffic must not flap the fleet."""
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=4,
+                                 slo_ttft_s=10.0, down_frac=0.5)
+    router.backlog = 0
+    router.ttfts = [7.0] * 10  # 0.5*10 < 7 < 10
+    for _ in range(3):
+        scaler.step()
+    assert fleet.added == [] and fleet.stopped == []
+    assert router._draining == set()
+
+
+def test_scale_down_drains_before_stopping(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 3, max_replicas=4,
+                                 min_replicas=1)
+    router.ttfts = [0.1]
+    router._outstanding = {0: 2, 1: 0, 2: 3}
+    scaler.step(now=100.0)
+    # victim: the highest-rid IDLE replica — rid 1 (0 and 2 are busy)
+    assert router._draining == {1} and fleet.stopped == []
+    # a request placed in the same poll round keeps the drain open
+    router._outstanding[1] = 1
+    scaler.step(now=101.0)
+    assert fleet.stopped == []
+    router._outstanding[1] = 0
+    scaler.step(now=102.0)
+    assert fleet.stopped == [1] and router.retired == [1]
+    assert scaler.scale_downs == 1
+    ev = [e for e in _journal_events(scaler) if e["ev"] == "scale"]
+    assert ev[-1]["dir"] == "down" and ev[-1]["replica"] == 1
+    assert ev[-1]["drained"] is True and ev[-1]["n_active"] == 2
+
+
+def test_scale_down_requires_an_idle_victim(tmp_path):
+    """Startup shape: p95 is None (nothing completed) and every ready
+    replica holds in-flight work — the fleet is busy, not cold, and
+    nothing may drain on the empty completion window."""
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=4,
+                                 min_replicas=1)
+    router._outstanding = {0: 3, 1: 2}
+    for _ in range(3):
+        scaler.step()
+    assert router._draining == set() and fleet.stopped == []
+
+
+def test_min_replicas_floor_blocks_drain(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 1, max_replicas=4,
+                                 min_replicas=1)
+    router.ttfts = [0.01]
+    for _ in range(3):
+        scaler.step()
+    assert fleet.stopped == [] and router._draining == set()
+
+
+def test_drain_timeout_forces_the_stop(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 2, max_replicas=4,
+                                 drain_timeout_s=0.0)
+    router.ttfts = [0.01]
+    router._outstanding = {0: 0, 1: 5}  # only rid 0 is an idle victim;
+    # force the timeout path by pinning outstanding after selection
+    scaler.step(now=100.0)
+    victim = next(iter(router._draining))
+    router._outstanding[victim] = 5  # never finishes
+    time.sleep(0.01)
+    scaler.step(now=101.0)
+    assert fleet.stopped == [victim]
+    ev = [e for e in _journal_events(scaler) if e["ev"] == "scale"]
+    assert ev[-1]["drained"] is False
+
+
+def test_swap_guard_defers_decisions(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 1, max_replicas=4)
+    router.backlog = 100
+    fleet.swap_active = True
+    scaler.step()
+    assert fleet.added == []
+    fleet.swap_active = False
+    scaler.step()
+    assert fleet.added == [1]
+
+
+def test_validates_bounds(tmp_path):
+    with pytest.raises(ValueError, match="min"):
+        _rig(tmp_path, 1, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="down_frac"):
+        _rig(tmp_path, 1, down_frac=1.0)
+
+
+# ============================================================== paid_idle
+
+def test_paid_idle_accrues_to_surplus_replicas_only(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 3, max_replicas=4,
+                                 min_replicas=1, cooldown_s=1e9)
+    router.backlog = 0
+    router.ttfts = [7.0]  # inside the hysteresis band: no scaling
+    scaler.step()
+    time.sleep(0.05)
+    scaler.step()
+    scaler.close(now=200.0)
+    ev = [e for e in _journal_events(scaler) if e["ev"] == "paid_idle"]
+    assert ev, "idle surplus capacity never journaled"
+    # charged to the HIGHEST rids beyond the floor (1 and 2, never 0)
+    assert {e["replica"] for e in ev} == {1, 2}
+    assert all(e["idle_s"] > 0 for e in ev)
+    assert scaler.summary()["paid_idle_s"] > 0
+
+
+def test_paid_idle_not_charged_under_load(tmp_path):
+    fleet, router, scaler = _rig(tmp_path, 3, max_replicas=3,
+                                 min_replicas=1, cooldown_s=1e9)
+    router.backlog = 4  # queue non-empty: capacity is NOT surplus
+    scaler.step()
+    time.sleep(0.02)
+    scaler.step()
+    scaler.close()
+    assert scaler.summary()["paid_idle_s"] == 0.0
+    assert not [e for e in _journal_events(scaler)
+                if e["ev"] == "paid_idle"]
+
+
+def test_aggregate_serving_rebooks_paid_idle(tmp_path):
+    """The goodput identity with the new category: paid_idle comes OUT
+    of serving (the replica was up, just unneeded), every second still
+    lands in exactly one bucket, accounted_frac == 1.0."""
+    d = str(tmp_path)
+    rd = goodput.replica_dir(d, 0)
+    os.makedirs(rd)
+    goodput.append_attempt(rd, {
+        "attempt": 0, "rc": 0, "t_spawn": 100.0, "t_exit": 110.0,
+        "duration_s": 10.0, "downtime_s": 0.0})
+    with open(goodput.serving_record_path(rd, 0), "w") as f:
+        json.dump({"attempt": 0, "wall_s": 10.0, "serving_s": 9.0,
+                   "drain_s": 0.5, "swap_s": 0.5}, f)
+    with open(goodput.serving_journal_path(d), "w") as f:
+        f.write(json.dumps({"ev": "paid_idle", "replica": 0,
+                            "idle_s": 4.0, "t": 105.0}) + "\n")
+    agg = aggregate_serving(d)
+    assert agg["paid_idle_s"] == pytest.approx(4.0)
+    assert agg["serving_s"] == pytest.approx(5.0)  # 9 - 4 re-booked
+    assert agg["accounted_frac"] == pytest.approx(1.0)
+    # clamp: paid_idle can never exceed what serving has to give
+    with open(goodput.serving_journal_path(d), "a") as f:
+        f.write(json.dumps({"ev": "paid_idle", "replica": 0,
+                            "idle_s": 100.0, "t": 106.0}) + "\n")
+    agg = aggregate_serving(d)
+    assert agg["serving_s"] == pytest.approx(0.0)
+    assert agg["paid_idle_s"] == pytest.approx(9.0)
+    assert agg["accounted_frac"] == pytest.approx(1.0)
+
+
+# ===================================================== affinity placement
+
+def _affinity_router(tmp_path, indices):
+    clients = {}
+    for rid, idx in indices.items():
+        rep = FakeReplica(rid)
+        rep.prefix_index = lambda idx=idx: idx
+        clients[rid] = rep
+    return Router(clients, str(tmp_path / "journal.jsonl"),
+                  affinity=True, page_size=4)
+
+
+def test_affinity_prefers_longest_leading_match(tmp_path):
+    prompt = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 9, 9, 9], np.int32)
+    from distributed_pipeline_tpu.serving.transport import (
+        prefix_block_hashes)
+    h = prefix_block_hashes(prompt, 4)
+    router = _affinity_router(tmp_path, {
+        0: (),            # cold
+        1: h[:1],         # one warm block
+        2: h[:2]})        # two warm blocks -> wins despite equal load
+    router.submit(prompt, 4)
+    router.poll()
+    rec = next(iter(router.records.values()))
+    assert rec.replica == 2
+    assert router.affinity_hits == 1 and router.affinity_placements == 1
+
+
+def test_affinity_leading_blocks_only(tmp_path):
+    """A replica advertising block 2 WITHOUT block 1 scores zero: the
+    KV pages only help if the request's pages hit from the start."""
+    prompt = np.asarray(list(range(1, 13)), np.int32)
+    from distributed_pipeline_tpu.serving.transport import (
+        prefix_block_hashes)
+    h = prefix_block_hashes(prompt, 4)
+    router = _affinity_router(tmp_path, {0: h[1:], 1: ()})
+    router.submit(prompt, 4)
+    router.poll()
+    rec = next(iter(router.records.values()))
+    assert rec.replica == 0  # tie at score 0 -> least-loaded order
+    assert router.affinity_hits == 0 and router.affinity_placements == 1
+
+
+def test_affinity_falls_back_to_least_loaded_when_cold(tmp_path):
+    prompt = np.asarray([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+    router = _affinity_router(tmp_path, {0: (), 1: ()})
+    busy = router.submit(np.asarray([9] * 8, np.int32), 4)
+    router.poll()
+    router.submit(prompt, 4)
+    router.poll()
+    recs = sorted(router.records.values(), key=lambda r: r.id)
+    assert recs[1].replica != busy.replica  # least-loaded tiebreak
+    assert router.affinity_hits == 0
+
+
+def test_affinity_never_overrides_health_gate(tmp_path):
+    prompt = np.asarray([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+    from distributed_pipeline_tpu.serving.transport import (
+        prefix_block_hashes)
+    h = prefix_block_hashes(prompt, 4)
+    router = _affinity_router(tmp_path, {0: h, 1: ()})
+    router.clients[0].beacon_age = 1e9  # warm replica is STALE
+    router.submit(prompt, 4)
+    router.poll()
+    rec = next(iter(router.records.values()))
+    assert rec.replica == 1, "affinity must lose to the health gate"
+
+
+def test_affinity_off_keeps_prefixes_empty(tmp_path):
+    router = Router({0: FakeReplica(0)},
+                    str(tmp_path / "journal.jsonl"))
+    rec = router.submit(np.asarray([1, 2, 3, 4], np.int32), 2)
+    assert rec.prefix == ()
+    router.poll()
+    assert router.affinity_placements == 0
+
+
+# =========================================== fleet workload (r13 NOTE)
+
+def _settings(**kw):
+    kw.setdefault("checkpoint_path", "unused")
+    kw.setdefault("traffic", "poisson")
+    kw.setdefault("seed", 7)
+    return ServeSettings(**kw)
+
+
+def test_fleet_workload_rejects_step_cadence_loudly():
+    with pytest.raises(SystemExit, match="arrival_every_steps"):
+        fleet_workload(_settings(arrival_every_steps=3), 64, 8)
+
+
+def test_fleet_workload_prompt_file_order_is_submission_order(tmp_path):
+    pf = tmp_path / "prompts.jsonl"
+    prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+    with open(pf, "w") as f:
+        for p in prompts:
+            f.write(json.dumps({"prompt_ids": p}) + "\n")
+    gen, reqs = fleet_workload(
+        _settings(prompt_file=str(pf), max_new_tokens=4), 64, 8)
+    assert [list(map(int, r[1])) for r in reqs] == prompts
+    offsets = [r[0] for r in reqs]
+    assert offsets == sorted(offsets), "file order must ride sorted offsets"
+
+
+def test_fleet_workload_deterministic_across_processes(tmp_path):
+    """Same seed + prompt file => identical (offset, prompt, mnt) triples
+    in a DIFFERENT interpreter — the cross-process determinism contract
+    the r13 NOTE demanded for fleet prompt ordering."""
+    pf = tmp_path / "prompts.jsonl"
+    with open(pf, "w") as f:
+        for i in range(6):
+            f.write(json.dumps({"prompt_ids": [i + 1, i + 2],
+                                "max_new_tokens": 3 + i % 2}) + "\n")
+    code = (
+        "import json\n"
+        "from distributed_pipeline_tpu.config.serve import ServeSettings\n"
+        "from distributed_pipeline_tpu.run.serve import fleet_workload\n"
+        "s = ServeSettings(checkpoint_path='unused', traffic='bursty',\n"
+        f"                  seed=7, prompt_file={json.dumps(str(pf))})\n"
+        "_, reqs = fleet_workload(s, 64, 8)\n"
+        "print(json.dumps([[t, list(map(int, p)), n]\n"
+        "                  for t, p, n in reqs]))\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    remote = json.loads(out.stdout.strip().splitlines()[-1])
+    _, reqs = fleet_workload(
+        _settings(traffic="bursty", prompt_file=str(pf)), 64, 8)
+    local = [[t, list(map(int, p)), n] for t, p, n in reqs]
+    assert remote == local
+
+
+def test_fleet_workload_synthetic_deterministic():
+    a = fleet_workload(_settings(synthetic_requests=5,
+                                 shared_prefix_len=4), 64, 8)[1]
+    b = fleet_workload(_settings(synthetic_requests=5,
+                                 shared_prefix_len=4), 64, 8)[1]
+    for (ta, pa, na), (tb, pb, nb) in zip(a, b):
+        assert ta == tb and na == nb
+        np.testing.assert_array_equal(pa, pb)
+
+
+# ======================================================= elastic e2e ring
+
+@pytest.mark.chaos
+def test_autoscaler_elastic_fleet_e2e(tmp_path):
+    """A real child-process fleet under the scaler: a burst grows the
+    fleet (the new replica is spawned, becomes ready, serves a second
+    traffic wave), the idle tail drains one back down, every request
+    completes token-identical, and the ledger (including paid_idle)
+    accounts every replica-second."""
+    ckpt = tmp_path / "ckpts"
+    _fake_ckpt(ckpt, 1, salt=4)
+    fleet, router = _start_fleet(tmp_path, 1, ckpt, token_interval=0.05)
+    scaler = AutoScaler(fleet, router, min_replicas=1, max_replicas=2,
+                        slo_ttft_s=30.0, up_backlog=1.0, down_frac=0.5,
+                        cooldown_s=0.2, window_s=60.0, drain_timeout_s=20.0)
+    try:
+        prompts = [np.arange(i + 1, i + 4, dtype=np.int32)
+                   for i in range(8)]
+        for p in prompts[:5]:
+            router.submit(p, 12)  # burst: backlog >> 1 per ready replica
+        scaler.step()  # sees the unplaced backlog -> structural scale-up
+        assert scaler.scale_ups == 1
+
+        wave2_sent = False
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            router.poll()
+            if not wave2_sent:
+                # emulate traffic arriving once the rollout lands: the
+                # least-loaded tiebreak steers it to the fresh replica
+                if 1 in fleet.ready_replicas() and router.healthy(1):
+                    for p in prompts[5:]:
+                        router.submit(p, 12)
+                    wave2_sent = True
+            else:
+                scaler.step()
+                if (router.all_done() and scaler.scale_downs >= 1
+                        and scaler._draining_rid is None):
+                    break
+            time.sleep(0.02)
+    finally:
+        scaler.close()
+        fleet.stop()
+    assert router.completed == 8
+    for rec, prompt in zip(sorted(router.records.values(),
+                                  key=lambda r: r.id), prompts):
+        assert rec.tokens == _expected_tokens(prompt, 12, salt=4)
+    assert scaler.scale_ups >= 1, "the burst never grew the fleet"
+    assert scaler.scale_downs >= 1, "the idle tail never drained one down"
+    assert fleet.n_replicas == 2  # rid 1 was spawned
+    # both replicas actually served (the scale-up took traffic)
+    assert {r.replica for r in router.records.values()} == {0, 1}
+    ev = goodput.read_journal(
+        goodput.serving_journal_path(str(tmp_path / "fleet")))
+    dirs = [e["dir"] for e in ev if e.get("ev") == "scale"]
+    assert "up" in dirs and "down" in dirs
+    agg = aggregate_serving(str(tmp_path / "fleet"))
+    assert agg["accounted_frac"] == pytest.approx(1.0, abs=0.05)

@@ -197,7 +197,7 @@ def test_bench_only_exact_match_with_optional_glob():
         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
         "diffuseq-base-seq128-tune",
         "gpt2-serve-decode-b64", "gpt2-base-decode-oneshot-b1",
-        "gpt2-serve-fleet-chaos")]
+        "gpt2-serve-fleet-chaos", "gpt2-serve-autoscale")]
     names = lambda got: [n for n, _ in got]
     assert names(bench.select_legs(legs, "diffuseq-base-seq128")) == \
         ["diffuseq-base-seq128"]
@@ -211,6 +211,10 @@ def test_bench_only_exact_match_with_optional_glob():
     # a timeout degrades to an error row, never a blocked headline)
     assert names(bench.select_legs(legs, "gpt2-serve-fleet-chaos")) == \
         ["gpt2-serve-fleet-chaos"]
+    # same contract for the autoscale leg (ISSUE 17): gpt2-named, so the
+    # diffuseq headline glob can never pick it up
+    assert names(bench.select_legs(legs, "gpt2-serve-autoscale")) == \
+        ["gpt2-serve-autoscale"]
     assert bench.select_legs(legs, "") == legs
     assert bench.select_legs(legs, "no-such-leg") == []
 
@@ -333,6 +337,83 @@ def test_fleet_bench_leg_meets_serving_slos(fleet_bench_run):
     assert row["accounted_frac"] == pytest.approx(1.0, abs=0.05)
     assert row["completed"] == row["requests"]
     assert row["replay_s"] >= 0 and row["fleet_attempts"] >= 4
+
+
+# -------------------------------------------------- autoscale fleet leg
+
+@pytest.fixture(scope="module")
+def autoscale_bench_run(tmp_path_factory):
+    """One bench subprocess filtered to the autoscaling-fleet leg
+    (ISSUE 17): three fleet runs over one checkpoint — affinity A/B,
+    static-max baseline, and --replicas 1 under the SLO autoscaler on
+    seeded diurnal traffic. BENCH_HISTORY is SET (unlike the other leg
+    fixtures): the acceptance also covers the row landing in the
+    history file under the regression sentinel's grouping."""
+    tmp = tmp_path_factory.mktemp("autoscale_bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "600",
+        "BENCH_LEG_BUDGET_S": "600",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        "BENCH_ONLY": "gpt2-serve-autoscale",
+        "BENCH_HISTORY": str(tmp / "history.jsonl"),
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=700)
+    return proc, tmp / "legs.jsonl", tmp / "history.jsonl"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_autoscale_bench_leg_meets_acceptance(autoscale_bench_run):
+    """ISSUE 17 acceptance row: >= 1 journaled scale-up AND drain-based
+    scale-down, zero drops, p95 TTFT under the documented CPU SLO, the
+    autoscaled replica-seconds bill strictly below the static-max
+    baseline, affinity's fleet-wide prefix hit rate strictly above
+    least-loaded's, and the ledger closing at accounted_frac 1.0 with
+    paid_idle booked."""
+    proc, artifact, _ = autoscale_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {r["name"]: r for r in
+            (json.loads(line) for line in
+             artifact.read_text().strip().splitlines())}
+    row = rows["gpt2-serve-autoscale"]
+    assert "error" not in row and "skipped" not in row, row
+    assert row["dropped"] == 0
+    assert row["completed"] == row["requests"]
+    assert row["scale_ups"] >= 1
+    assert row["scale_downs"] >= 1
+    assert row["ttft_p50_s"] <= row["slo_p50_s"]
+    assert row["ttft_p95_s"] <= row["slo_p95_s"]
+    assert row["replica_seconds"] < row["static_replica_seconds"]
+    assert row["replica_seconds_saved_frac"] > 0
+    assert row["prefix_hit_rate_affinity"] > \
+        row["prefix_hit_rate_least_loaded"]
+    assert row["paid_idle_s"] is not None and row["paid_idle_s"] >= 0
+    assert row["accounted_frac"] == pytest.approx(1.0, abs=0.05)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_autoscale_bench_row_lands_in_history(autoscale_bench_run):
+    """ISSUE 17 satellite: the new leg's row rides bench_history.jsonl
+    under the obs/regress.py sentinel — stamped with the invocation's
+    run_id and grouped as one run by the sentinel's own reader."""
+    from distributed_pipeline_tpu.chaos.goodput import read_journal
+    from distributed_pipeline_tpu.obs import regress as regress_lib
+
+    proc, _, history = autoscale_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = read_journal(str(history))
+    mine = [r for r in rows if r["name"] == "gpt2-serve-autoscale"]
+    assert len(mine) == 1 and "error" not in mine[0]
+    assert mine[0].get("run_id") and "t" in mine[0]
+    runs = regress_lib.group_runs(rows)
+    assert len(runs) == 1 and runs[0][0] == mine[0]["run_id"]
 
 
 # ------------------------------------------------------ auto-tuner leg

@@ -502,19 +502,22 @@ def _expected_tokens(prompt, n, salt):
 
 def _start_fleet(tmp_path, n, ckpt_dir, *, token_interval=0.01,
                  hang_timeout_s=0.0, max_restarts=3, stale_beacon_s=10.0,
-                 extra_argv=()):
+                 extra_argv=(), transport="file", affinity=False):
     fleet_dir = str(tmp_path / "fleet")
+    worker_argv = ["--checkpoint_dir", str(ckpt_dir), "--step", "1",
+                   "--token_interval_s", str(token_interval), *extra_argv]
+    if transport != "file":
+        worker_argv += ["--serve_transport", transport]
     fleet = ServingFleet(
-        fleet_dir, n, "tests._fleet_child",
-        ["--checkpoint_dir", str(ckpt_dir), "--step", "1",
-         "--token_interval_s", str(token_interval), *extra_argv],
+        fleet_dir, n, "tests._fleet_child", worker_argv,
         hang_timeout_s=hang_timeout_s, max_restarts=max_restarts,
         restart_backoff_s=0.1, restart_backoff_max_s=0.5,
-        monitor_interval=0.02)
+        monitor_interval=0.02, transport=transport)
     fleet.start()
     router = Router(fleet.clients(),
                     goodput.serving_journal_path(fleet_dir),
-                    stale_beacon_s=stale_beacon_s)
+                    stale_beacon_s=stale_beacon_s, affinity=affinity,
+                    page_size=4)
     deadline = time.time() + 20
     while len(fleet.ready_replicas()) < n and time.time() < deadline:
         time.sleep(0.02)

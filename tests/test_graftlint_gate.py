@@ -57,6 +57,13 @@ GATED_PATHS = [
     # StageMath jit surfaces (GL007 territory: per-step host syncs on
     # link frames are the design, stray ones inside jit are not)
     os.path.join(ROOT, "tests", "test_mpmd.py"),
+    # the transport tests drive socket/file replica clients and the
+    # fleet e2e rings over both wires — router/fleet host-loop territory
+    # (GL007) like test_fleet.py, which they import helpers from
+    os.path.join(ROOT, "tests", "test_transport.py"),
+    # the autoscaler tests drive the fleet poll loop + scale decisions
+    # and the elastic e2e ring — the same host-loop breeding ground
+    os.path.join(ROOT, "tests", "test_autoscale.py"),
 ]
 
 

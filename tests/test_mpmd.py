@@ -342,6 +342,47 @@ def test_mpmd_pipeline_matches_single_program_trainer(tmp_path):
     np.testing.assert_allclose(out["losses"], ref, rtol=2e-5)
 
 
+@pytest.mark.parametrize("family", ["gpt2", "diffuseq"])
+def test_sliced_init_bit_identical_to_slice_of_full(family):
+    """The sliced-init path (r18 NOTE follow-up): StageMath slices the
+    full init INSIDE its jit (XLA DCE skips what a stage never keeps,
+    so xl stages stop paying whole-model init memory) — every stage's
+    params must stay BIT-identical to slicing a fully materialized
+    init, for both families and every stage position."""
+    import flax.linen as nn
+    import jax
+    from distributed_pipeline_tpu.models import create_model_from_config
+    from distributed_pipeline_tpu.mpmd.stage_math import (
+        StageMath, stage_param_bounds, stage_param_slice)
+
+    model = dict(model_family=family, vocab_size=64, seq_len=16,
+                 hidden_size=32, num_layers=4, num_heads=2,
+                 dtype="float32", scan_layers=True)
+    if family == "diffuseq":
+        model["diffusion_steps"] = 50
+    cfg = {"n_stages": 2, "model": model, "batch_size": 8, "seed": 3,
+           "data": dict(dataset="synthetic-lm", seq_len=16,
+                        vocab_size=64, seed=0)}
+
+    # reference: the pre-r19 path — materialize the WHOLE init, slice
+    wl = create_model_from_config(**model)
+    init_rng = jax.random.fold_in(jax.random.PRNGKey(3), 0)
+    full = jax.jit(lambda r: nn.meta.unbox(wl.init_params(r)))(init_rng)
+
+    for stage in range(2):
+        sm = StageMath(cfg, stage)
+        lo, hi = stage_param_bounds(wl.num_layers, stage, 2)
+        ref = stage_param_slice(full["params"], family, lo, hi,
+                                stage == 0, stage == 1)
+        flat_ref = jax.tree_util.tree_leaves_with_path(ref)
+        flat_got = jax.tree_util.tree_leaves_with_path(sm.params)
+        assert [p for p, _ in flat_got] == [p for p, _ in flat_ref]
+        for (path, got), (_, want) in zip(flat_got, flat_ref):
+            got, want = np.asarray(got), np.asarray(want)
+            assert got.dtype == want.dtype and got.shape == want.shape
+            assert (got == want).all(), f"stage {stage} {path}"
+
+
 # --------------------------------------- disaggregated serving (token id)
 
 

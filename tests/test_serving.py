@@ -327,8 +327,10 @@ def test_prefix_cache_refcount_blocks_early_free(wl_and_params):
 
 def test_prefix_cache_unit_refcounts():
     """PrefixCache bookkeeping in isolation: acquire refs, release frees
-    only the private tail, eviction skips slot-ref'd entries and frees a
-    page only when it leaves its last entry."""
+    only the private tail, and a page is freed exactly when it leaves
+    both its last entry and its last slot ref — eviction may drop a
+    slot-ref'd entry (orphaning its pages) but the pages come back only
+    through release."""
     from distributed_pipeline_tpu.serving import PageManager, PrefixCache
 
     mgr = PageManager(num_pages=9, page_size=4)
@@ -343,14 +345,69 @@ def test_prefix_cache_unit_refcounts():
     tail = cache.release(prompt, pages)
     assert tail.tolist() == [int(p) for p in pages[2:]]
     mgr.free(tail)
-    # still slot-ref'd from the second acquire: nothing evictable
+    # slot-ref'd from the second acquire: pool-pressure eviction drops
+    # the entries but frees NOTHING — the live reader keeps its pages
+    free_before = mgr.free_pages
     assert cache.evict_for(mgr.capacity + 1) == 0
-    cache.release(prompt, np.asarray(shared, np.int32))
-    # now idle: eviction frees both shared pages (both entries drop)
-    freed = cache.evict_for(mgr.free_pages + 2)
-    assert freed == 2
-    assert mgr.free_pages == mgr.capacity
+    assert mgr.free_pages == free_before
     assert cache.stats()["prefix_entries"] == 0
+    # ...and the orphaned pages come back with the LAST slot ref
+    back = cache.release(prompt, np.asarray(shared, np.int32))
+    assert sorted(back.tolist()) == sorted(int(p) for p in pages[:2])
+    mgr.free(back)
+    assert mgr.free_pages == mgr.capacity
+
+
+def test_prefix_cache_eviction_never_deadlocks_shared_prefix_churn():
+    """Regression (ISSUE 17, found by the autoscale bench leg): under a
+    shared-prefix workload every cache entry's head pages are slot-ref'd
+    by the request being admitted, and an eviction policy that skips
+    such entries wholesale can free NOTHING — pool exhausted, admission
+    waits forever, the worker spins with beacons ticking (so not even
+    the watchdog fires). Churn many unique requests over one shared
+    prefix through a tight pool: each admission must succeed because
+    eviction drops cold entries and frees their unshared pages even
+    while the hot shared head stays pinned."""
+    from distributed_pipeline_tpu.serving import PageManager, PrefixCache
+
+    # the bench shape: page 4, prompt 12 (3 full pages, 8 shared
+    # tokens), gen 8 -> 5 pages/request, 2 slots -> 17-page pool
+    mgr = PageManager(num_pages=17, page_size=4)
+    cache = PrefixCache(mgr)
+    shared8 = np.arange(100, 108, dtype=np.int32)
+
+    def admit(i):
+        prompt = np.concatenate(
+            [shared8, np.asarray([i, i + 1, i + 2, i + 3], np.int32)])
+        shared, covered = cache.acquire(prompt)
+        need = 5 - len(shared)
+        fresh = mgr.alloc(need)
+        if fresh is None:                      # the scheduler's path
+            cache.evict_for(need)
+            fresh = mgr.alloc(need)
+        assert fresh is not None, \
+            f"admission {i} deadlocked: pool exhausted, nothing evicted"
+        pages = np.concatenate(
+            [np.asarray(shared, np.int32), fresh]) if shared else fresh
+        cache.publish(prompt, pages, n_acquired=len(shared))
+        return prompt, pages
+
+    live = []
+    for i in range(40):                        # >> pool capacity
+        live.append(admit(i))
+        if len(live) == 2:                     # 2 decode slots
+            prompt, pages = live.pop(0)
+            freeable = cache.release(prompt, pages)
+            if freeable.size:
+                mgr.free(freeable)
+    for prompt, pages in live:
+        freeable = cache.release(prompt, pages)
+        if freeable.size:
+            mgr.free(freeable)
+    # invariant after the churn: every page is either free or resident
+    # in the cache — nothing leaked, nothing double-freed
+    assert mgr.free_pages + cache.resident_pages == mgr.capacity
+    assert cache.stats()["prefix_hits"] >= 38  # the shared head stayed hot
 
 
 def test_eos_finishes_early_and_frees_slot(wl_and_params):
