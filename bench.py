@@ -227,7 +227,7 @@ def main() -> None:
                 moe_capacity_factor: float = 1.25,
                 scan_layers: bool = False,
                 prefetch_depth: int = 0, dispatch_lag: int = 0,
-                steady_steps: int = 0):
+                steady_steps: int = 0, fused_update: bool = False):
         """tokens/sec for one config; the first step is timed separately
         (compile + dispatch) from the steady-state window. ``batch`` is PER
         HOST (reference trainer.py:89 semantics: global = batch x hosts); a
@@ -247,7 +247,8 @@ def main() -> None:
                                    scan_layers=scan_layers,
                                    prefetch_depth=prefetch_depth,
                                    dispatch_lag=dispatch_lag,
-                                   steady_steps=steady_steps)
+                                   steady_steps=steady_steps,
+                                   fused_update=fused_update)
                 except (LegTimeout, BenchInterrupted):
                     # Not an OOM: the per-leg SIGALRM cap / driver SIGTERM
                     # must reach the leg runner, not restart at a smaller
@@ -290,7 +291,8 @@ def main() -> None:
                          log_interval=10 ** 9, save_interval=10 ** 9,
                          mesh=make_mesh(dp=-1), checkpoint_dir="", seed=0,
                          sanitize=True, prefetch_depth=prefetch_depth,
-                         dispatch_lag=dispatch_lag, cost_ledger=True)
+                         dispatch_lag=dispatch_lag, cost_ledger=True,
+                         fused_update=fused_update)
         # First step paid separately: with the AOT step (utils/trainer.py)
         # its wall time is compile + dispatch + one step, and
         # loop.compile_time_s isolates the lower()/compile() share — the
@@ -378,6 +380,48 @@ def main() -> None:
         row.update(_train_ledger_columns(loop, tps=tps, fpt=fpt,
                                          steps_per_s=n_steady / dt,
                                          stall=stall))
+        if fused_update:
+            # Fused-update HBM accounting (ISSUE 18): kernel arm = the
+            # exact per-step traffic of the one-pass kernel
+            # (ops/fused_update.py update_hbm_bytes — the TPU lowering's
+            # bytes by construction; interpreter emulation can't be
+            # cost-analyzed faithfully); XLA twin = cost analysis of the
+            # staged optax chain this path replaces, compiled standalone
+            # on the leg's own state shapes.
+            import optax as _optax
+
+            from distributed_pipeline_tpu.ops.fused_update import (
+                update_hbm_bytes,
+            )
+            st = loop.state
+            tmap = jax.tree_util.tree_map
+            rates = loop.ema_rates
+            rate_val = {r: float(r) for r in rates}  # hoisted: trace-free
+
+            def staged(params, grads, opt_state, ema):
+                updates, ns = loop.opt.update(grads, opt_state, params)
+                p2 = _optax.apply_updates(params, updates)
+                e2 = {r: tmap(lambda e, p, _r=rate_val[r]:
+                              e * _r + p * (1.0 - _r), ema[r], p2)
+                      for r in rates}
+                return p2, ns, e2
+
+            abstract = tmap(lambda x: jax.ShapeDtypeStruct(x.shape,
+                                                           x.dtype),
+                            (st.params, st.params, st.opt_state, st.ema))
+            twin = jax.jit(staged).lower(*abstract).compile()
+            xla_bytes = ledger_lib.extract_cost(twin).get(
+                "bytes_accessed", 0.0)
+            kernel_bytes = update_hbm_bytes(
+                st.params, n_ema_rates=len(rates),
+                dtype_bytes=2 if dtype == "bfloat16" else 4)
+            row.update({
+                "fused_update": True,
+                "update_hbm_bytes_per_step": kernel_bytes,
+                "xla_update_bytes_per_step": round(xla_bytes, 1),
+                "update_bytes_ratio": round(
+                    kernel_bytes / max(xla_bytes, 1e-9), 4),
+            })
         return row
 
     def measure_decode(name: str, *, gen_tokens: int, batch: int,
@@ -514,6 +558,120 @@ def main() -> None:
             "first_request_s": round(first_request_s, 3),
             "recompile_count": steady_recompiles,
             **ledger_cols,
+        }
+
+    def measure_serve_decode_kernel(name: str, *, slots: int,
+                                    num_requests: int, gen_tokens: int,
+                                    prompt_len: int, page_size: int,
+                                    seq_len: int, vocab: int = 8192):
+        """Flash-decode acceptance leg (ISSUE 18): the measure_serve
+        protocol with ``decode_impl='pallas'`` (ops/flash_decode.py — the
+        paged pool streamed straight through the kernel, interpreter mode
+        on CPU), cross-checked token-for-token against a ``'xla'`` twin
+        run on the SAME prompts, plus the HBM bytes/token comparison:
+        ``decode_hbm_bytes_per_token`` is the kernel schedule's exact DMA
+        traffic (decode_hbm_bytes — the TPU lowering's bytes by grid-spec
+        construction; interpreter emulation can't be cost-analyzed
+        faithfully) and ``xla_decode_bytes_per_token`` is XLA cost
+        analysis of the gather twin (xla_paged_decode) compiled standalone
+        at the identical pool geometry. Acceptance: token identity, zero
+        steady recompiles, kernel bytes strictly below the twin's."""
+        import numpy as np
+
+        from distributed_pipeline_tpu.ops.flash_decode import (
+            decode_hbm_bytes,
+            xla_paged_decode,
+        )
+        from distributed_pipeline_tpu.serving import DecodeServer
+
+        dims = dict(vocab_size=vocab) if on_tpu else dict(
+            hidden_size=64, num_layers=2, num_heads=4, vocab_size=256)
+        wl = create_model_from_config(
+            model_family="gpt2", model_size="base", seq_len=seq_len,
+            dtype=dtype, **dims)
+        params = wl.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompts = rng.integers(
+            4, dims["vocab_size"], (num_requests, prompt_len)).astype(
+                np.int32)
+
+        def serve(impl):
+            server = DecodeServer(
+                wl, params, decode_slots=slots, page_size=page_size,
+                max_prompt_len=prompt_len, max_len=prompt_len + gen_tokens,
+                seed=0, sanitize=True, decode_impl=impl)
+            try:
+                reqs = [server.submit(prompts[0],
+                                      max_new_tokens=gen_tokens)]
+                server.drain()
+                warm = server.recompile_count
+                server.reset_stats()
+                t0 = time.perf_counter()
+                for p in prompts[1:]:
+                    reqs.append(server.submit(p,
+                                              max_new_tokens=gen_tokens))
+                server.drain()
+                dt = time.perf_counter() - t0
+                steady = server.recompile_count - warm
+                tps = server.tokens_fetched / dt
+            finally:
+                server.stop_sanitizer()
+            return [r.tokens for r in reqs], tps, steady
+
+        toks_pl, tps_pl, rec_pl = serve("pallas")
+        toks_xla, tps_xla, rec_xla = serve("xla")
+        if toks_pl != toks_xla:
+            bad = sum(1 for a, b in zip(toks_pl, toks_xla) if a != b)
+            return {"name": name,
+                    "error": f"flash-decode token mismatch vs xla path on "
+                             f"{bad}/{len(toks_pl)} requests"}
+
+        # --- HBM bytes/token, both arms at the server's pool geometry.
+        # Kernel arm: the schedule's exact bytes summed over the steady
+        # occupancy trajectory (every slot live, positions advancing one
+        # page-aligned token per step — the saturated-service shape).
+        h = wl.model.num_heads
+        dh = wl.hidden_size // h
+        dtype_bytes = 2 if dtype == "bfloat16" else 4
+        n_pages = -(-(prompt_len + gen_tokens) // page_size)
+        bt = np.arange(1 + slots * n_pages)[1:].reshape(slots, n_pages)
+        kernel_bytes = sum(
+            decode_hbm_bytes(bt, np.full(slots, prompt_len + t, np.int64),
+                             page_size, h, dh, dtype_bytes)
+            for t in range(gen_tokens))
+        kernel_per_tok = kernel_bytes * wl.num_layers / (
+            slots * gen_tokens)
+        # XLA twin: cost analysis of the gather path it replaces, compiled
+        # standalone on the same shapes (position-independent: the gather
+        # always materializes every reserved page).
+        pool_pages = 1 + slots * n_pages
+        jdt = jax.numpy.dtype("bfloat16") if dtype == "bfloat16" \
+            else jax.numpy.dtype("float32")
+        abstract = (
+            jax.ShapeDtypeStruct((slots, h, dh), jdt),
+            jax.ShapeDtypeStruct((pool_pages, page_size, h, dh), jdt),
+            jax.ShapeDtypeStruct((pool_pages, page_size, h, dh), jdt),
+            jax.ShapeDtypeStruct((slots, n_pages), jax.numpy.int32),
+            jax.ShapeDtypeStruct((slots,), jax.numpy.int32),
+        )
+        twin = jax.jit(xla_paged_decode).lower(*abstract).compile()
+        xla_bytes = ledger_lib.extract_cost(twin).get("bytes_accessed", 0.0)
+        xla_per_tok = xla_bytes * wl.num_layers / slots
+        return {
+            "name": name,
+            "decode_impl": "pallas",
+            "tokens_identical_to_xla": True,
+            "decode_tokens_per_s_per_chip": round(tps_pl, 1),
+            "xla_decode_tokens_per_s_per_chip": round(tps_xla, 1),
+            "batch": slots, "gen_tokens": gen_tokens,
+            "prompt_len": prompt_len, "page_size": page_size,
+            "requests": num_requests,
+            "recompile_count": rec_pl,
+            "xla_recompile_count": rec_xla,
+            "decode_hbm_bytes_per_token": round(kernel_per_tok, 1),
+            "xla_decode_bytes_per_token": round(xla_per_tok, 1),
+            "hbm_bytes_ratio": round(
+                kernel_per_tok / max(xla_per_tok, 1e-9), 4),
         }
 
     def _run_supervised_ring(run_dir_name: str, plan: dict, ring_args,
@@ -825,7 +983,11 @@ def main() -> None:
                "--swap_after_requests", str(swap_after),
                "--swap_step", "4",
                "--hang_timeout_s", str(hang_timeout_s),
-               "--fleet_deadline_s", str(max(30.0, timeout_s - 25.0))]
+               "--fleet_deadline_s", str(max(30.0, timeout_s - 25.0)),
+               # per-replica roofline snapshots -> fleet decode_roofline
+               # aggregate, so this row carries mfu_gap_memory_bound like
+               # the single-replica serve rows (ISSUE 18 satellite)
+               "--cost_ledger", "true"]
         t0 = time.perf_counter()
         proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -893,6 +1055,9 @@ def main() -> None:
             "traffic": res.get("traffic"),
             "wall_s": res.get("wall_s"),
             "leg_wall_s": round(wall, 1),
+            # fleet-averaged decode roofline attribution (gap terms keyed
+            # mfu / mfu_gap_* like every attributed row)
+            **(res.get("decode_roofline") or {}),
         }
 
     def measure_serve_autoscale(name: str, *, requests: int = 20,
@@ -1786,6 +1951,17 @@ def main() -> None:
             microbatch=64 if on_tpu else 8, seq_len=128,
             window_steps=10 if on_tpu else 6,
             rounds=6 if on_tpu else 8)),
+        # Fused optimizer+EMA update leg (ISSUE 18): the headline shape
+        # with --fused_update (ops/fused_update.py one-pass kernel;
+        # interpreter mode on CPU), landing the kernel's exact bytes/step
+        # next to the staged optax chain's cost-analysis bytes
+        # (acceptance: strictly below, losses bit-identical — the parity
+        # suite owns the loss check, this row owns the traffic claim).
+        ("diffuseq-base-seq128-fusedupd", functools.partial(
+            measure, "diffuseq-base-seq128-fusedupd", family="diffuseq",
+            size="base", seq_len=128, batch=bsz(256),
+            microbatch=bsz(256) // 4 or 1,
+            steady_steps=30 if on_tpu else 6, fused_update=True)),
         # Trace-overhead guard (ISSUE 12): span tracing ON vs OFF at the
         # headline settings, paired-interleaved like the other A/B twins.
         # The contract is a noise-band claim — tracing must cost within
@@ -1827,6 +2003,18 @@ def main() -> None:
             prompt_len=128 if on_tpu else 8,
             page_size=16 if on_tpu else 4,
             seq_len=1024 if on_tpu else 64, prefill_batch=16)),
+        # Flash-decode kernel leg (ISSUE 18): decode_impl=pallas through
+        # the same continuous-batching protocol, token-identity checked
+        # against an xla twin run, with the kernel's schedule-exact HBM
+        # bytes/token landed next to the gather path's cost-analysis
+        # bytes (acceptance: strictly below).
+        ("gpt2-serve-decode-kernel", functools.partial(
+            measure_serve_decode_kernel, "gpt2-serve-decode-kernel",
+            slots=8, num_requests=25 if on_tpu else 6,
+            gen_tokens=128 if on_tpu else 12,
+            prompt_len=128 if on_tpu else 8,
+            page_size=16 if on_tpu else 4,
+            seq_len=1024 if on_tpu else 64)),
         ("gpt2-base-decode-oneshot-b1", functools.partial(
             measure_decode, "gpt2-base-decode-oneshot-b1",
             gen_tokens=128 if on_tpu else 24,

@@ -43,6 +43,11 @@ GL011 cross-module-key-reuse  the same PRNG key flowing into two
                            iteration without rebinding — the reuse
                            GL001 cannot see because the consumers live
                            behind calls (graph-only rule)
+GL012 stray-pallas-call    pl.pallas_call outside ops/ — kernels live
+                           behind the ops/ dispatch seams (auto/forced
+                           impl knobs, interpret fallback, layout
+                           contracts); a call site elsewhere bypasses
+                           dispatch, fallback AND the bench accounting
 
 Interprocedural halves (callgraph.py, ISSUE 15): GL002, GL003, GL005
 and GL007 each carry a ``check_graph`` in addition to their per-module
@@ -1137,3 +1142,52 @@ class CrossModuleKeyReuse(Rule):
 
     def check_graph(self, graph: Any) -> Iterator[Finding]:
         return graph.iter_cross_module_key_reuse(self)
+
+
+# --------------------------------------------------------------------- GL012
+
+
+_OPS_DIR = "/ops/"
+_PALLAS_ROOTS = ("jax.experimental.pallas", "jax._src.pallas")
+
+
+@register
+class StrayPallasCall(Rule):
+    """GL012: ``pl.pallas_call`` outside ``ops/`` — kernels live behind
+    the ops/ dispatch seams (``resolve_decode_impl`` auto/forced knobs,
+    ``interpret=`` CPU fallback, the (8, 128) layout contracts and the
+    schedule-derived HBM byte accounting the bench legs report). A call
+    site anywhere else gets none of that: it hard-fails off-TPU, dodges
+    the impl knob the configs thread through the stack, and its bytes
+    never reach the ledger, so the kernel's roofline win is invisible
+    to regress.py."""
+
+    code = "GL012-stray-pallas-call"
+    description = ("pl.pallas_call outside ops/ bypasses the dispatch "
+                   "seam, interpret fallback and bench byte accounting")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if _OPS_DIR in path or path.startswith("ops/"):
+            return
+        suggestion = ("wrap the kernel in distributed_pipeline_tpu/ops/ "
+                      "behind an impl='auto'|'pallas'|'xla' dispatch "
+                      "function (see ops/flash_decode.py) and call the "
+                      "seam instead")
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and not isinstance(
+                    module.parent.get(node), ast.Attribute):
+                fn = module.resolve(node)
+                if fn and fn.startswith(_PALLAS_ROOTS) \
+                        and fn.endswith(".pallas_call"):
+                    yield module.finding(
+                        self, node,
+                        f"{fn} used outside ops/ — {suggestion}")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.startswith(_PALLAS_ROOTS) and any(
+                        a.name == "pallas_call" for a in node.names):
+                    yield module.finding(
+                        self, node,
+                        f"pallas_call imported from {mod} outside ops/ "
+                        f"— {suggestion}")

@@ -82,6 +82,12 @@ class ServeSettings(S):
                               "state — prefill/decode compile exactly "
                               "once) and disallow implicit host<->device "
                               "transfers during dispatch")
+    decode_impl: Literal["auto", "pallas", "xla"] = _(
+        "auto", "decode-step attention kernel (ops/flash_decode.py): "
+                "'pallas' streams K/V pages straight from the paged pool "
+                "through a flash-decode kernel (no gathered copy); 'xla' "
+                "is the gather+dot reference; 'auto' picks pallas on TPU "
+                "and xla elsewhere")
     prefix_cache: bool = _(False, "shared-prefix KV page reuse: requests "
                                   "whose prompts open with the same token "
                                   "run share the paged-KV pages holding "

@@ -69,6 +69,10 @@ class SelfAttention(nn.Module):
     decode: bool = False
     paged_pages: int = 0
     page_size: int = 0
+    # Paged DECODE-step kernel (ops/flash_decode.py): "auto" -> flash-decode
+    # on TPU / XLA gather elsewhere; "pallas"/"xla" force. Distinct from
+    # attention_impl, which picks the full-sequence (train/prefill) kernel.
+    decode_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -107,8 +111,8 @@ class SelfAttention(nn.Module):
         # function-level import: paged_kv is a leaf module (jax-only), so
         # models <- serving here is a cycle-free convenience, same pattern
         # as Block's moe import
-        from ..serving.paged_kv import gather_kv, write_prompt_kv, \
-            write_token_kv
+        from ..ops.flash_decode import paged_decode_attention
+        from ..serving.paged_kv import write_prompt_kv, write_token_kv
         B, H, L, Dh = q.shape
         pk = self.variable("cache", "pages_k", jnp.zeros,
                            (self.paged_pages, self.page_size, H, Dh), k.dtype)
@@ -129,16 +133,16 @@ class SelfAttention(nn.Module):
         idx = jnp.asarray(cache_index, jnp.int32)
         pk.value = write_token_kv(pk.value, block_table, k[:, :, 0], idx)
         pv.value = write_token_kv(pv.value, block_table, v[:, :, 0], idx)
-        ks = gather_kv(pk.value, block_table)   # [B, H, Lmax, Dh]
-        vs = gather_kv(pv.value, block_table)
-        # Positions beyond each slot's own depth hold trash/stale pages;
-        # mask them (causality IS this mask for one query row). Masked
-        # entries contribute exact zeros to the softmax, so at equal padded
-        # length this is bit-identical to the dense cache path.
-        live = (jnp.arange(ks.shape[2])[None, :] <= idx[:, None]).astype(
-            jnp.int32)
-        return dot_product_attention(q, ks, vs, live, causal=False,
-                                     impl="xla")
+        # The decode_step seam: positions beyond each slot's own depth hold
+        # trash/stale pages and are masked (causality IS this mask for one
+        # query row). The XLA path gathers a dense [B, H, Lmax, Dh] view
+        # and masks it — bit-identical to the dense cache path at equal
+        # padded length; the pallas path (ops/flash_decode.py) reads live
+        # pages straight from the pool, matching to float tolerance
+        # (greedy-token identical — tests/test_kernels.py).
+        o = paged_decode_attention(q[:, :, 0], pk.value, pv.value,
+                                   block_table, idx, impl=self.decode_impl)
+        return o[:, :, None]
 
     def _cached_attention(self, q, k, v, pad_mask, cache_index):
         B, H, L, Dh = q.shape
@@ -208,6 +212,7 @@ class Block(nn.Module):
     moe_no_drop: bool = False
     paged_pages: int = 0
     page_size: int = 0
+    decode_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -219,6 +224,7 @@ class Block(nn.Module):
                               self.attention_impl, self.decode,
                               paged_pages=self.paged_pages,
                               page_size=self.page_size,
+                              decode_impl=self.decode_impl,
                               name="attn")(h, pad_mask, cache_index,
                                            block_table)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -259,6 +265,7 @@ class TransformerBackbone(nn.Module):
     scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
     paged_pages: int = 0  # serving: paged KV cache pool size (0 = dense)
     page_size: int = 0
+    decode_impl: str = "auto"  # paged decode-step kernel (SelfAttention)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -310,6 +317,7 @@ class TransformerBackbone(nn.Module):
                           moe_no_drop=self.moe_no_drop,
                           paged_pages=self.paged_pages,
                           page_size=self.page_size,
+                          decode_impl=self.decode_impl,
                           name=f"block_{i}")(x, pad_mask, cache_index,
                                              block_table)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x).astype(self.dtype)

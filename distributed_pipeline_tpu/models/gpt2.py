@@ -49,6 +49,7 @@ class GPT2Model(nn.Module):
     scan_unroll: int = 0  # layer-scan unroll (pipeline.scan_unroll_for)
     paged_pages: int = 0  # serving: paged KV-cache pool size (0 = dense)
     page_size: int = 0
+    decode_impl: str = "auto"  # paged decode-step kernel (flash-decode/xla)
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
@@ -96,6 +97,7 @@ class GPT2Model(nn.Module):
                                 scan_unroll=self.scan_unroll,
                                 paged_pages=self.paged_pages,
                                 page_size=self.page_size,
+                                decode_impl=self.decode_impl,
                                 name="backbone")(h, pad_mask, cache_index,
                                                  block_table)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the

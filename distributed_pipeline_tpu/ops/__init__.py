@@ -1,1 +1,3 @@
 from .attention import dot_product_attention
+from .flash_decode import paged_decode_attention
+from .fused_update import fused_adamw_ema

@@ -1,0 +1,288 @@
+"""Flash-decode: single-query attention straight out of the paged KV pool.
+
+The serving decode step (models/backbone.py ``_paged_attention``, single-
+token branch) is pure XLA today: ``gather_kv`` materializes a dense
+``[B, H, pages_per_slot * page_size, Dh]`` copy of every slot's pages in HBM
+— dead tail pages included — then masked softmax attention re-reads it. Per
+generated token that is ~3x the live K/V bytes (pool read + copy write +
+copy read), and it scales with the slot's page RESERVATION, not its live
+length. This kernel removes the copy: each grid step DMAs ONE live page
+``[page_size, H, Dh]`` directly from the pool through the slot's block
+table, folds it into online-softmax scratch in VMEM, and writes only the
+``[B, H, Dh]`` output. Dead pages and inactive slots never enter the
+schedule (the compressed-step-table trick from ops/flash_attention.py).
+
+Step table (computed ON DEVICE inside the jitted decode step — positions
+and block tables are data, so the table costs no recompile and no host
+sync): a static worst-case ``[B * pages_per_slot, 7]`` int32 array of
+``(slot, page_id, first, last, needs_mask, page_base, pos)`` rows. Live
+rows cover exactly each slot's ``pos // page_size + 1`` live pages in
+slot-major order (a contiguous accumulation run per slot); dead rows are
+packed at the tail and route to the trash page and a zero query row, so on
+TPU consecutive dead steps re-DMA nothing (identical index-map output) and
+the run's first/last flags make them self-contained no-ops. ``needs_mask``
+is set only on a slot's LAST live page — the one place the within-page
+``position <= pos`` compare is not vacuous (interior pages are fully live).
+
+Page-layout contract (what TP layouts and int8 pages must keep to ride
+this kernel later):
+
+* pool is ``[num_pages, page_size, H, Dh]`` per layer, K and V separate;
+  page 0 is the trash page (serving/paged_kv.py) — the kernel never reads
+  it through a live step, dead steps may;
+* a block-table row lists a slot's pages head-first; entries past the live
+  prefix may be anything (trash, stale, shared) — the schedule never
+  visits them;
+* positions are absolute token indices; the row at ``pos % page_size`` of
+  page ``pos // page_size`` must already hold the current token's K/V
+  (the caller writes via ``write_token_kv`` BEFORE attending);
+* page sharing (serving/paged_kv.py ``PrefixCache``) is invisible here:
+  two slots listing the same page id just schedule two DMAs of it;
+* on real TPU the ``(H, Dh)`` trailing dims of a page block must tile the
+  ``(8, 128)`` f32 layout; pools that don't (small models) dispatch to the
+  XLA path under ``impl="auto"`` — see :func:`resolve_decode_impl`. Int8
+  pages will need ``(32, 128)`` tiles and a dequant in ``_compute``; the
+  schedule and contract above are unchanged.
+
+Dispatch: ``impl="auto"`` -> this kernel on TPU (layout permitting), the
+XLA gather path elsewhere; ``"pallas"`` forces the kernel (interpreter
+mode off-TPU — CPU tests exercise the real kernel logic); ``"xla"`` forces
+the gather path. Numerics: the kernel's online softmax reassociates the
+sum, so outputs match the XLA path to float tolerance, not bitwise — the
+serving contract is greedy-token identity (tests/test_kernels.py).
+
+HBM accounting: :func:`decode_hbm_bytes` reproduces the schedule's DMA
+traffic exactly (blocks x steps, consecutive-identical reuse deducted) —
+this is the kernel-arm number the ``gpt2-serve-decode-kernel`` bench leg
+lands next to the XLA twin's cost-analysis bytes, because interpreter-mode
+emulation (scan + full-array updates) does not share the kernel's memory
+profile and cannot be cost-analyzed faithfully off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific bits are unavailable in some CPU-only wheels
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_decode", "paged_decode_attention", "resolve_decode_impl",
+           "decode_hbm_bytes", "xla_paged_decode"]
+
+NEG_INF = -1e9
+LANES = 128
+TRASH_PAGE = 0  # mirrors serving/paged_kv.py (leaf module, no import cycle)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def resolve_decode_impl(impl: str, page_shape=None) -> str:
+    """``auto`` -> "pallas" on TPU when the page layout tiles, else "xla".
+
+    ``page_shape`` is the pool's ``[P, page_size, H, Dh]`` (optional: auto
+    on TPU without it assumes tileable). Forced values pass through."""
+    if impl in ("pallas", "xla"):
+        return impl
+    if impl != "auto":
+        raise ValueError(f"decode impl must be auto|pallas|xla, got {impl!r}")
+    if pltpu is None or jax.default_backend() != "tpu":
+        return "xla"
+    if page_shape is not None:
+        _, _, h, dh = page_shape
+        if h % 8 != 0 or dh % LANES != 0:  # pragma: no cover — TPU-only
+            return "xla"  # layout contract: (H, Dh) must tile (8, 128)
+    return "pallas"  # pragma: no cover — TPU-only
+
+
+def _build_steps(block_table: jnp.ndarray, positions: jnp.ndarray,
+                 page_size: int, n_slots: int) -> jnp.ndarray:
+    """Traced ``[B * n_pages, 7]`` step table (module docstring): live rows
+    packed first, slot-major; dead rows route to (slot=B, trash page,
+    pos=-1) so they mask to zero and re-DMA nothing on TPU."""
+    B, n = block_table.shape
+    pos = positions.astype(jnp.int32)
+    n_live = jnp.minimum(pos // page_size + 1, n)              # [B]
+    j = jnp.arange(n, dtype=jnp.int32)
+    live = j[None, :] < n_live[:, None]                        # [B, n]
+    slot = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, n))
+    first = (j[None, :] == 0) & live
+    last = (j[None, :] == n_live[:, None] - 1) & live
+    base = jnp.broadcast_to((j * page_size)[None, :], (B, n))
+    posb = jnp.broadcast_to(pos[:, None], (B, n))
+    dead = (~live).reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(dead, stable=True)  # stable: keeps slot-major order
+    dsel = dead[order]
+
+    def pack(x, fill):
+        return jnp.where(dsel == 1, fill,
+                         x.reshape(-1)[order]).astype(jnp.int32)
+
+    return jnp.stack([
+        pack(slot, n_slots), pack(block_table, TRASH_PAGE),
+        pack(first.astype(jnp.int32), 1), pack(last.astype(jnp.int32), 1),
+        # needs_mask == last: only a slot's final page is partially live
+        pack(last.astype(jnp.int32), 1),
+        pack(base, 0), pack(posb, -1)], axis=1)
+
+
+def _decode_kernel(steps_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale: float):
+    t = pl.program_id(0)
+
+    @pl.when(steps_ref[t, 2] == 1)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                    # [H, Dh]
+    k = k_ref[0]                    # [page_size, H, Dh]
+    v = v_ref[0]
+    # s[h, t] = q[h, :] . k[t, h, :]: head-batched single-query scores
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) * scale      # [H, page_size]
+
+    def _fold(apply_mask):
+        sl = s
+        if apply_mask:
+            tglob = steps_ref[t, 5] + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            sl = jnp.where(tglob <= steps_ref[t, 6], sl, NEG_INF)
+        m_prev = m_ref[:, :1]                            # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(sl, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sl - m_new)
+        if apply_mask:  # exact zeros for masked entries (fully-dead rows
+            # would otherwise softmax over the raw trash scores)
+            p = jnp.where(sl > NEG_INF / 2, p, 0.0)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(steps_ref[t, 4] == 0)
+    def _interior():  # fully-live page: skip the iota/compare mask
+        _fold(False)
+
+    @pl.when(steps_ref[t, 4] == 1)
+    def _boundary():
+        _fold(True)
+
+    @pl.when(steps_ref[t, 3] == 1)
+    def _finalize():
+        # Dead runs have l == 0 exactly; emit zeros, not NaNs.
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def flash_decode(q: jnp.ndarray, pages_k: jnp.ndarray, pages_v: jnp.ndarray,
+                 block_table: jnp.ndarray,
+                 positions: jnp.ndarray) -> jnp.ndarray:
+    """Paged single-query attention: ``q`` [B, H, Dh], pool
+    ``[P, page_size, H, Dh]``, ``block_table`` [B, n_pages], ``positions``
+    [B] -> [B, H, Dh]. Attends positions ``0..positions[b]`` of each slot
+    through its block table; everything later is skipped at schedule level.
+    """
+    if pltpu is None:  # pragma: no cover — CPU wheels without pallas-TPU
+        return xla_paged_decode(q, pages_k, pages_v, block_table, positions)
+    B, H, Dh = q.shape
+    _, page_size, _, _ = pages_k.shape
+    steps = _build_steps(block_table, positions, page_size, B)
+    # Row B is the dead-step sink: zero query in, garbage-free zeros out.
+    qp = jnp.concatenate([q, jnp.zeros((1, H, Dh), q.dtype)], axis=0)
+    n_steps = steps.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_steps,),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda t, s: (s[t, 0], 0, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, page_size, H, Dh),
+                         lambda t, s: (s[t, 1], 0, 0, 0), memory_space=_VMEM),
+            pl.BlockSpec((1, page_size, H, Dh),
+                         lambda t, s: (s[t, 1], 0, 0, 0), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda t, s: (s[t, 0], 0, 0),
+                               memory_space=_VMEM),
+        scratch_shapes=[
+            _VMEM((H, Dh), jnp.float32),      # acc
+            _VMEM((H, LANES), jnp.float32),   # running max (lane-replicated)
+            _VMEM((H, LANES), jnp.float32),   # running normalizer
+        ])
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=Dh ** -0.5),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B + 1, H, Dh), q.dtype),
+        interpret=_interpret())(steps, qp, pages_k, pages_v)
+    return out[:B]
+
+
+def xla_paged_decode(q: jnp.ndarray, pages_k: jnp.ndarray,
+                     pages_v: jnp.ndarray, block_table: jnp.ndarray,
+                     positions: jnp.ndarray) -> jnp.ndarray:
+    """The gather-path twin ([B, H, Dh] in/out), kept callable standalone so
+    the bench leg can cost-analyze the seam it replaces."""
+    from ..serving.paged_kv import gather_kv
+    from .attention import dot_product_attention
+    ks = gather_kv(pages_k, block_table)        # [B, H, n*page_size, Dh]
+    vs = gather_kv(pages_v, block_table)
+    live = (jnp.arange(ks.shape[2])[None, :]
+            <= positions[:, None]).astype(jnp.int32)
+    o = dot_product_attention(q[:, :, None], ks, vs, live, causal=False,
+                              impl="xla")
+    return o[:, :, 0]
+
+
+def paged_decode_attention(q, pages_k, pages_v, block_table, positions,
+                           impl: str = "auto") -> jnp.ndarray:
+    """The decode-step seam: dispatch one generated token's attention.
+
+    ``q`` [B, H, Dh]; returns [B, H, Dh]. The caller has already written
+    the token's K/V into the pool (page-layout contract)."""
+    if resolve_decode_impl(impl, pages_k.shape) == "pallas":
+        return flash_decode(q, pages_k, pages_v, block_table, positions)
+    return xla_paged_decode(q, pages_k, pages_v, block_table, positions)
+
+
+def decode_hbm_bytes(block_table: np.ndarray, positions: np.ndarray,
+                     page_size: int, n_heads: int, head_dim: int,
+                     dtype_bytes: int = 4) -> int:
+    """Exact HBM bytes one kernel invocation DMAs, from its own schedule.
+
+    Counts, per live step, the K and V page blocks (re-fetches of the page
+    just visited are free: consecutive identical index-map outputs skip the
+    DMA, which also zero-rates the packed dead tail), plus one q read and
+    one output write per slot run and the SMEM step table. This is the
+    TPU lowering's traffic by construction of the grid spec; the bench leg
+    uses it as the kernel-arm number because interpreter mode cannot be
+    cost-analyzed faithfully (module docstring)."""
+    bt = np.asarray(block_table)
+    pos = np.asarray(positions)
+    B, n = bt.shape
+    page_bytes = page_size * n_heads * head_dim * dtype_bytes
+    qo_bytes = n_heads * head_dim * dtype_bytes
+    n_live = np.minimum(pos // page_size + 1, n)
+    total = 0
+    prev_page = None
+    for b in range(B):
+        for j in range(int(n_live[b])):
+            page = int(bt[b, j])
+            if page != prev_page:
+                total += 2 * page_bytes            # K and V blocks
+            prev_page = page
+        total += 2 * qo_bytes                      # q read + out write
+    total += (B * n) * 7 * 4                       # step table (SMEM)
+    return int(total)

@@ -1,0 +1,232 @@
+"""Fused AdamW + EMA weight update: one pass over every state copy.
+
+The trainer's XLA update (utils/trainer.py ``train_step``) chains
+``optax.adamw`` -> ``apply_updates`` -> one ``update_ema`` tree-map per EMA
+rate. On TPU each stage is its own fusion island, so a param leaf is read
+back from HBM once per state copy: params' re-read for every EMA rate, the
+Adam moments round-tripping between scale_by_adam and the weight-decay /
+schedule stages. This kernel does the whole update in ONE pass per leaf:
+read param/grad/mu/nu plus every EMA copy once, write param'/mu'/nu' plus
+every EMA copy once — ``(4 + R)`` reads and ``(3 + R)`` writes of leaf
+bytes, versus the staged path's re-reads (R = number of EMA rates).
+
+Bit-parity contract: the kernel body replays optax's exact op sequence —
+``mu' = (1-b1)*g + b1*mu``; ``nu' = (1-b2)*g^2 + b2*nu``;
+``u = (mu'/bc1) / (sqrt(nu'/bc2) + eps)``; ``u += wd*p``;
+``u *= -lr``; ``p' = p + u``; ``e' = e*rate + p'*(1-rate)`` — with the
+per-step scalars (``-lr``, the ``1 - beta**count_inc`` bias corrections)
+computed OUTSIDE the kernel by the same expressions optax uses and fed in
+as data, so no recompile tracks the schedule. Losses under the fused path
+are bit-identical to the optax path (tests/test_kernels.py); the optimizer
+state keeps optax's exact pytree structure (ScaleByAdamState counts
+increment identically), so checkpoints, ZeRO-1 shardings and restore are
+oblivious to which path wrote them.
+
+ZeRO-1 composition: the caller (trainer) runs this inside the jitted train
+step with mu/nu/EMA constrained to the zshard layout (parallel/partition
+``zero1_shardings``) and out_shardings pinned — the update is elementwise,
+so GSPMD partitions each leaf's kernel over the data axis and every shard
+touches only its own slice; no layout changes here.
+
+Off-TPU the kernel runs in Pallas interpreter mode (real kernel logic on
+CPU, tier-1 testable). HBM accounting for the bench leg:
+:func:`update_hbm_bytes` is the kernel's exact per-step traffic from the
+read/write census above — interpreter-mode emulation can't be
+cost-analyzed faithfully (see ops/flash_decode.py) — and the XLA twin is
+measured by cost analysis of the staged update compiled standalone.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific bits are unavailable in some CPU-only wheels
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["fused_adamw_ema", "update_hbm_bytes"]
+
+LANES = 128
+_BLOCK_ROWS = 256  # rows per grid step: 256x128 f32 = 128 KiB per operand
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _update_kernel(steps_ref, scal_ref, p_ref, g_ref, mu_ref, nu_ref,
+                   *rest, b1: float, b2: float, eps: float, wd: float,
+                   rates: Tuple[float, ...]):
+    """optax.adamw's elementwise tail + every EMA lerp, one block pass.
+    ``rest`` is (ema_in..., p_out, mu_out, nu_out, ema_out...)."""
+    del steps_ref  # prefetch slot unused: no routing, blocks stream in order
+    n_r = len(rates)
+    e_in = rest[:n_r]
+    p_out, mu_out, nu_out = rest[n_r], rest[n_r + 1], rest[n_r + 2]
+    e_out = rest[n_r + 3:]
+    step_size = scal_ref[0, 0]   # -lr (already schedule-evaluated)
+    bc1 = scal_ref[1, 0]         # 1 - b1**count_inc
+    bc2 = scal_ref[2, 0]
+    p = p_ref[...]
+    g = g_ref[...]
+    # Exact optax op order (module docstring) — reassociating any of these
+    # breaks the bit-parity contract.
+    mu = (1 - b1) * g + b1 * mu_ref[...]
+    nu = (1 - b2) * (g * g) + b2 * nu_ref[...]
+    u = (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+    u = u + wd * p
+    u = step_size * u
+    pn = p + u
+    p_out[...] = pn.astype(p_out.dtype)
+    mu_out[...] = mu
+    nu_out[...] = nu
+    for i, r in enumerate(rates):
+        e_out[i][...] = e_in[i][...] * r + pn * (1.0 - r)
+
+
+def _xla_leaf_update(p, g, mu, nu, emas, scalars, b1, b2, eps, wd, rates):
+    """Same math as the kernel, flat jax ops — the fallback for wheels
+    without pallas-TPU grid support (pltpu import failed)."""
+    step_size, bc1, bc2 = scalars[0], scalars[1], scalars[2]
+    mu2 = (1 - b1) * g + b1 * mu
+    nu2 = (1 - b2) * (g * g) + b2 * nu
+    u = (mu2 / bc1) / (jnp.sqrt(nu2 / bc2) + eps)
+    u = step_size * (u + wd * p)
+    pn = (p + u).astype(p.dtype)
+    return pn, mu2, nu2, [e * r + pn * (1.0 - r) for e, r in zip(emas, rates)]
+
+
+def _leaf_update(p, g, mu, nu, emas: List[jnp.ndarray], scalars,
+                 b1: float, b2: float, eps: float, wd: float,
+                 rates: Tuple[float, ...]):
+    """Run one leaf through the kernel: flatten -> [rows, LANES] blocks."""
+    if pltpu is None:  # pragma: no cover — CPU wheels without pallas-TPU
+        return _xla_leaf_update(p, g, mu, nu, emas, scalars,
+                                b1, b2, eps, wd, rates)
+    shape, dt = p.shape, p.dtype
+    n = p.size
+    rows = -(-n // LANES)
+    br = min(_BLOCK_ROWS, max(8, rows))
+    rows_p = -(-rows // br) * br
+
+    def to2d(x):
+        flat = jnp.pad(x.reshape(-1), (0, rows_p * LANES - n))
+        return flat.reshape(rows_p, LANES)
+
+    ins = [to2d(x) for x in (p, g, mu, nu, *emas)]
+    svec = jnp.broadcast_to(scalars[:, None], (scalars.shape[0], LANES))
+    n_out = 3 + len(emas)
+    blk = pl.BlockSpec((br, LANES), lambda i, s: (i, 0), memory_space=_VMEM)
+    sblk = pl.BlockSpec(svec.shape, lambda i, s: (0, 0), memory_space=_VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(rows_p // br,),
+        in_specs=[sblk] + [blk] * len(ins),
+        out_specs=[blk] * n_out,
+        scratch_shapes=[])
+    outs = pl.pallas_call(
+        functools.partial(_update_kernel, b1=b1, b2=b2, eps=eps, wd=wd,
+                          rates=rates),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((rows_p, LANES), dt)] * n_out,
+        interpret=_interpret())(jnp.zeros((1, 1), jnp.int32), svec, *ins)
+
+    def back(x):
+        return x.reshape(-1)[:n].reshape(shape)
+
+    return back(outs[0]), back(outs[1]), back(outs[2]), \
+        [back(o) for o in outs[3:]]
+
+
+def fused_adamw_ema(params: Any, grads: Any, opt_state: Any,
+                    ema: Dict[str, Any], *, lr_fn, b1: float = 0.9,
+                    b2: float = 0.999, eps: float = 1e-8,
+                    weight_decay: float = 0.0) -> Tuple[Any, Any, Dict]:
+    """Drop-in replacement for the trainer's staged update:
+    ``opt.update -> apply_updates -> update_ema per rate`` in one kernel
+    pass per leaf.
+
+    ``opt_state`` must be the state of ``optax.adamw`` (ScaleByAdamState
+    first, optional ScaleByScheduleState last — exactly what the trainer's
+    ``_make_optimizer`` builds); it is returned with the same structure and
+    identically-incremented counts. ``lr_fn`` maps the (pre-increment) step
+    count to the learning rate — the trainer passes ``_lr_at`` or a
+    constant, matching what it handed optax. ``ema`` maps rate strings to
+    params-shaped trees."""
+    adam = opt_state[0]
+    count_inc = optax.safe_int32_increment(adam.count)
+    # The same expressions optax evaluates per step (bias_correction /
+    # scale_by_schedule), hoisted out of the per-leaf kernels as data.
+    bc1 = 1 - b1 ** count_inc
+    bc2 = 1 - b2 ** count_inc
+    step_size = -lr_fn(adam.count)
+    scalars = jnp.stack([jnp.asarray(step_size, jnp.float32),
+                         bc1.astype(jnp.float32), bc2.astype(jnp.float32)])
+    rate_keys = list(ema.keys())
+    rates = tuple(float(r) for r in rate_keys)
+
+    leaves_p, tdef = jax.tree_util.tree_flatten(params)
+    leaves_g = jax.tree_util.tree_leaves(grads)
+    leaves_mu = jax.tree_util.tree_leaves(adam.mu)
+    leaves_nu = jax.tree_util.tree_leaves(adam.nu)
+    leaves_e = [jax.tree_util.tree_leaves(ema[r]) for r in rate_keys]
+    pn: List[jnp.ndarray] = []
+    mun: List[jnp.ndarray] = []
+    nun: List[jnp.ndarray] = []
+    en: List[List[jnp.ndarray]] = [[] for _ in rate_keys]
+    for i in range(len(leaves_p)):
+        a, m, v, es = _leaf_update(
+            leaves_p[i], leaves_g[i], leaves_mu[i], leaves_nu[i],
+            [leaves_e[j][i] for j in range(len(rate_keys))],
+            scalars, b1, b2, eps, weight_decay, rates)
+        pn.append(a)
+        mun.append(m)
+        nun.append(v)
+        for j in range(len(rate_keys)):
+            en[j].append(es[j])
+
+    unflatten = functools.partial(jax.tree_util.tree_unflatten, tdef)
+    new_adam = adam._replace(count=count_inc, mu=unflatten(mun),
+                             nu=unflatten(nun))
+    rest = [
+        s._replace(count=optax.safe_int32_increment(s.count))
+        if "count" in getattr(s, "_fields", ()) else s
+        for s in opt_state[1:]
+    ]
+    new_ema = {r: unflatten(en[j]) for j, r in enumerate(rate_keys)}
+    return unflatten(pn), (new_adam, *rest), new_ema
+
+
+def update_hbm_bytes(params: Any, n_ema_rates: int,
+                     dtype_bytes: int = 4) -> int:
+    """Exact HBM bytes one fused update step moves: ``(4 + R)`` reads and
+    ``(3 + R)`` writes of every leaf, plus the per-leaf scalar row. The
+    kernel-arm number for the ``diffuseq-base-seq128-fusedupd`` bench leg
+    (module docstring: why not cost analysis off-TPU)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    total = 0
+    for leaf in leaves:
+        n = int(np_size(leaf))
+        total += (4 + n_ema_rates + 3 + n_ema_rates) * n * dtype_bytes
+        total += 3 * 4 * LANES  # broadcast scalar row per kernel launch
+    return int(total)
+
+
+def np_size(leaf) -> int:
+    size = getattr(leaf, "size", None)
+    if size is not None:
+        return int(size)
+    shape = getattr(leaf, "shape", ())
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out

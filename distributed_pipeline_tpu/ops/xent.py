@@ -15,9 +15,21 @@ Fills the loss-stub surface of the reference scaffold
 (``/root/reference/utils/trainer.py:23-31`` leaves ``compute_losses`` to the
 user); both concrete workloads (models/diffuseq.py, models/gpt2.py) route
 their vocab NLL through here.
+
+Vocab-parallel variant: when the LM head is tensor-sharded the logits
+arrive VOCAB-SHARDED — each tensor rank holds ``[..., V/tp]``. All-gathering
+them back to ``[..., V]`` just to take a softmax moves ``(tp-1)/tp`` of the
+biggest activation in the model over the interconnect. ``axis_name``
+switches :func:`token_cross_entropy` to the collective decomposition
+(Megatron-LM's vocab-parallel loss): a ``pmax`` of the local max, a ``psum``
+of the local exp-sum, and a ``psum`` of the target logit masked to the one
+shard that owns it — three scalar-per-token collectives instead of the
+[B, L, V] all-gather.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,12 +38,40 @@ __all__ = ["token_cross_entropy"]
 
 
 def token_cross_entropy(logits: jnp.ndarray,
-                        targets: jnp.ndarray) -> jnp.ndarray:
+                        targets: jnp.ndarray,
+                        axis_name: Optional[str] = None) -> jnp.ndarray:
     """Per-token ``-log p(target)`` for ``logits [..., V]``, ``targets [...]``
     (int). Softmax statistics accumulate in f32 regardless of logits dtype;
-    the convert fuses into the reduction so bf16 logits are read once."""
+    the convert fuses into the reduction so bf16 logits are read once.
+
+    With ``axis_name`` the logits are the LOCAL vocab shard ``[..., V/tp]``
+    of a tensor axis of that name, ``targets`` hold GLOBAL vocab ids
+    (replicated across the axis), and the return value is the full-vocab
+    NLL, identical on every rank."""
     l32 = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(l32, axis=-1)
-    tgt = jnp.take_along_axis(
-        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    return lse - tgt.astype(jnp.float32)
+    if axis_name is None:
+        lse = jax.nn.logsumexp(l32, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return lse - tgt.astype(jnp.float32)
+
+    v_local = logits.shape[-1]
+    shard = jax.lax.axis_index(axis_name)
+    lo = shard.astype(jnp.int32) * v_local
+    # global logsumexp from shard-local pieces: global max first (pmax) so
+    # every rank subtracts the SAME max — exp sums then add exactly
+    m = jax.lax.pmax(jnp.max(l32, axis=-1), axis_name)
+    s = jax.lax.psum(jnp.sum(jnp.exp(l32 - m[..., None]), axis=-1),
+                     axis_name)
+    lse = m + jnp.log(s)
+    # target gather: clamp the local index so the take stays in-bounds on
+    # the tp-1 ranks that don't own the target, zero their contribution,
+    # and let the psum deliver the owner's value everywhere
+    t = targets.astype(jnp.int32)
+    local = t - lo
+    owns = (local >= 0) & (local < v_local)
+    safe = jnp.clip(local, 0, v_local - 1)
+    tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    tgt = jax.lax.psum(
+        jnp.where(owns, tgt.astype(jnp.float32), 0.0), axis_name)
+    return lse - tgt

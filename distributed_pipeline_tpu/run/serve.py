@@ -173,7 +173,8 @@ def _serve_single(settings: ServeSettings) -> dict:
         top_p=settings.top_p, seed=settings.seed,
         eos_id=settings.eos_id if settings.eos_id >= 0 else None,
         mesh=mesh, sanitize=settings.sanitize,
-        prefix_cache=settings.prefix_cache)
+        prefix_cache=settings.prefix_cache,
+        decode_impl=settings.decode_impl)
 
     pending = _load_requests(settings, max_prompt_len, wl.model.vocab_size)
     logger.info(f"serving {len(pending)} requests on {settings.decode_slots} "
@@ -323,7 +324,8 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
         top_p=settings.top_p, seed=settings.seed,
         eos_id=settings.eos_id if settings.eos_id >= 0 else None,
         mesh=mesh, sanitize=settings.sanitize,
-        prefix_cache=settings.prefix_cache)
+        prefix_cache=settings.prefix_cache,
+        decode_impl=settings.decode_impl)
 
     def _restore_params(target: str):
         # the abstract target's shardings place the tree during restore
@@ -679,7 +681,8 @@ def _disagg_decode_main(settings: ServeSettings) -> dict:
         temperature=settings.temperature, top_k=settings.top_k,
         top_p=settings.top_p, seed=settings.seed,
         eos_id=settings.eos_id if settings.eos_id >= 0 else None,
-        mesh=mesh, sanitize=settings.sanitize)
+        mesh=mesh, sanitize=settings.sanitize,
+        decode_impl=settings.decode_impl)
     n_peers = max(1, settings.disagg_peers)
     kv_links = [FileStageLink(
         os.path.join(settings.disagg_links, f"kv_{i}"),
@@ -1032,6 +1035,26 @@ def _fleet_main(settings: ServeSettings) -> dict:
             prefix_hits += int(rec.get("prefix_hits") or 0)
             prefix_misses += int(rec.get("prefix_misses") or 0)
 
+    # fleet-wide decode roofline (ISSUE 18 satellite): average the
+    # replicas' serve_decode attribution rows (each worker's --cost_ledger
+    # snapshot in its replica dir) so the fleet summary — and the bench
+    # rows built from it — carry mfu_gap_memory_bound next to goodput
+    decode_roofline = None
+    if settings.cost_ledger:
+        from ..obs import ledger as ledger_lib
+        decs = []
+        for rdir in goodput.list_replica_dirs(fleet_dir):
+            led = ledger_lib.read_ledger(rdir)
+            dec = (led or {}).get("programs", {}).get("serve_decode")
+            if isinstance(dec, dict) and "mfu" in dec:
+                decs.append(ledger_lib.attribution_columns(dec))
+        if decs:
+            keys = ("mfu",) + ledger_lib.GAP_TERMS
+            decode_roofline = {
+                k: round(sum(float(d.get(k) or 0.0) for d in decs)
+                         / len(decs), 4) for k in keys}
+            decode_roofline["replicas_reporting"] = len(decs)
+
     result = {
         "mode": "fleet",
         "replicas": settings.replicas,
@@ -1057,6 +1080,7 @@ def _fleet_main(settings: ServeSettings) -> dict:
         "prefix_misses": prefix_misses,
         "prefix_hit_rate": round(
             prefix_hits / max(1, prefix_hits + prefix_misses), 4),
+        "decode_roofline": decode_roofline,
         "autoscale": scaler.summary() if scaler is not None else None,
         "serving_goodput": {
             k: (round(v, 4) if isinstance(v, float) else v)

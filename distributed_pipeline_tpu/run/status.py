@@ -224,6 +224,13 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
             "mfu": (round(float(dec["mfu"]), 4)
                     if isinstance(dec.get("mfu"), (int, float))
                     else None),
+            # decode is memory-bound: this one gap term IS the kernel
+            # headroom (ops/flash_decode.py), so the fleet table shows it
+            # per replica next to the MFU it explains
+            "mfu_gap_memory_bound": (
+                round(float(dec["mfu_gap_memory_bound"]), 4)
+                if isinstance(dec.get("mfu_gap_memory_bound"),
+                              (int, float)) else None),
             "tokens_per_s": (round(float(dec["tokens_per_s"]), 1)
                              if isinstance(dec.get("tokens_per_s"),
                                            (int, float)) else None),
@@ -271,8 +278,8 @@ def render(snap: dict) -> str:
     if snap["kind"] == "fleet":
         headers = ["replica", "state", "attempt", "params_step", "tick",
                    "beacon_age_s", "in_flight", "serving_s", "drain_s",
-                   "swap_s", "prefix_hit_rate", "mfu", "tokens_per_s",
-                   "attempts"]
+                   "swap_s", "prefix_hit_rate", "mfu",
+                   "mfu_gap_memory_bound", "tokens_per_s", "attempts"]
         out.append(_table(headers, [[r.get(h) for h in headers]
                                     for r in snap["replicas"]]))
         out.append(

@@ -485,6 +485,7 @@ def main(namespace: argparse.Namespace) -> None:
         # the per-run partition-rule override — from the parsed artifact
         # (tuner output or a hand-written table; parallel/partition.py).
         shard_optimizer=args.shard_optimizer,
+        fused_update=args.fused_update,
         partition_rules=(artifact or {}).get("rules"),
         # Span tracing (obs/): --trace arms explicitly; the default
         # defers to the DPT_TRACE launcher env, so supervised rings

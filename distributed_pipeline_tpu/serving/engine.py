@@ -103,6 +103,7 @@ class DecodeEngine:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng: Optional[jax.Array] = None, seed: int = 0,
                  mesh=None, transfer_guard: bool = False,
+                 decode_impl: str = "auto",
                  on_compile: Optional[Callable[[str, float], None]] = None):
         model = workload.model
         if workload.family != "gpt2":
@@ -143,8 +144,11 @@ class DecodeEngine:
         bp = self.prefill_batch
         # decode=True + paged_pages selects the paged attention branch;
         # inference never drops MoE tokens (models/sampling.py rationale)
+        # decode_impl picks the decode-step attention kernel behind the
+        # ROADMAP-reserved seam (ops/flash_decode.py dispatch rules)
         dm = model.clone(decode=True, moe_no_drop=True,
-                         paged_pages=max_pages, page_size=page_size)
+                         paged_pages=max_pages, page_size=page_size,
+                         decode_impl=decode_impl)
         pick = _slot_picker(temperature, top_k, top_p)
 
         def prefill_fn(p, cache, ids, prompt_lens, slot_map, slot_tables,

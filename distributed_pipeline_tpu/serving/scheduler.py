@@ -101,7 +101,8 @@ class DecodeServer:
                  rng: Optional[jax.Array] = None, eos_id: Optional[int] = None,
                  mesh=None, sanitize: bool = False,
                  dispatch_lag: int = 1,
-                 prefix_cache: bool = False) -> None:
+                 prefix_cache: bool = False,
+                 decode_impl: str = "auto") -> None:
         max_len = max_len or workload.seq_len
         max_prompt_len = max_prompt_len or max(2, max_len // 2)
         pages_per_slot = -(-max_len // page_size)
@@ -121,7 +122,7 @@ class DecodeServer:
                 prefill_batch=prefill_batch, decode_span=decode_span,
                 temperature=temperature,
                 top_k=top_k, top_p=top_p, rng=rng, seed=seed, mesh=mesh,
-                transfer_guard=sanitize)
+                transfer_guard=sanitize, decode_impl=decode_impl)
         except BaseException:
             self._recompiles.uninstall()  # failed build must not leak the
             raise                         # process-global 'jax' log handler

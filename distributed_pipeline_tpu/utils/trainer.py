@@ -49,6 +49,7 @@ from ..data.device_prefetch import DeviceBatch, prefetch_to_device
 from ..models import Workload
 from ..obs import ledger as ledger_lib
 from ..obs import trace as trace_lib
+from ..ops.fused_update import fused_adamw_ema
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition as partition_lib
 from ..parallel.sharding import (
@@ -128,6 +129,7 @@ class TrainLoop:
         progress_file: str = "",
         recompute_until_step: int = 0,
         shard_optimizer: bool = False,
+        fused_update: bool = False,
         partition_rules: Optional[Sequence[Tuple[str, Any]]] = None,
         trace: Optional[bool] = None,
         profile_steps: str = "",
@@ -261,6 +263,12 @@ class TrainLoop:
         # sharded across the data mesh axis with gather-on-use inside the
         # compiled step (per-replica weight-update memory / ~dp).
         self.shard_optimizer = shard_optimizer
+        # --fused_update swaps the staged optax update (opt.update ->
+        # apply_updates -> one EMA tree-map per rate) for the single-pass
+        # Pallas kernel (ops/fused_update.py); losses stay bit-identical
+        # and the opt_state pytree keeps optax's structure, so checkpoints
+        # and ZeRO-1 shardings don't care which path wrote them.
+        self.fused_update = fused_update
         self.partition_rules = (tuple(partition_rules)
                                 if partition_rules else None)
         self.goodput = GoodputTracker(t0=self._construct_t0)
@@ -642,12 +650,24 @@ class TrainLoop:
             if clip > 0:  # reference grad_clip, trainer.py:246-255
                 scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            updates, opt_state = opt.update(grads, state.opt_state,
-                                            state.params)
-            params = optax.apply_updates(state.params, updates)
-            params = jax.lax.with_sharding_constraint(params, pshard)
-            ema = {r: update_ema(state.ema[r], params, rate_of[r])
-                   for r in rates}
+            if self.fused_update:
+                # single-pass kernel (ops/fused_update.py): same opt_state
+                # structure, bit-identical losses — the optax chain below
+                # is the reference twin
+                lr_fn = (self._lr_at
+                         if self.learning_steps > 0 or self.warmup_steps > 0
+                         else lambda _c: jnp.asarray(self.lr, jnp.float32))
+                params, opt_state, ema = fused_adamw_ema(
+                    state.params, grads, state.opt_state, state.ema,
+                    lr_fn=lr_fn, weight_decay=self.weight_decay)
+                params = jax.lax.with_sharding_constraint(params, pshard)
+            else:
+                updates, opt_state = opt.update(grads, state.opt_state,
+                                                state.params)
+                params = optax.apply_updates(state.params, updates)
+                params = jax.lax.with_sharding_constraint(params, pshard)
+                ema = {r: update_ema(state.ema[r], params, rate_of[r])
+                       for r in rates}
             metrics = dict(metrics)
             metrics["grad_norm"] = gnorm          # device scalar — no sync
             metrics["lr"] = lr_at(state.step)

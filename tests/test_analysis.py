@@ -58,7 +58,8 @@ def test_catalog_has_all_rules():
                      "GL008-hand-wired-sharding",
                      "GL009-ad-hoc-timing",
                      "GL010-unattributed-flops",
-                     "GL011-cross-module-key-reuse"):
+                     "GL011-cross-module-key-reuse",
+                     "GL012-stray-pallas-call"):
         assert expected in got
 
 
@@ -1019,6 +1020,37 @@ def test_gl011_does_not_duplicate_local_use_after_split(tmp_path):
     """)
     assert "GL001-key-reuse" in codes(fs)
     assert "GL011-cross-module-key-reuse" not in codes(fs)
+
+
+# ------------------------------------------------------------------- GL012
+
+
+_PALLAS_SNIPPET = """
+    import jax.experimental.pallas as pl
+    def fast(x):
+        return pl.pallas_call(lambda ref, out: None, out_shape=x)(x)
+"""
+
+
+def test_gl012_pallas_call_outside_ops_flagged(tmp_path):
+    fs = lint(tmp_path, _PALLAS_SNIPPET, name="serving/engine.py")
+    got = [f for f in fs if f.rule == "GL012-stray-pallas-call"]
+    assert len(got) == 1
+    assert "dispatch" in got[0].message
+
+
+def test_gl012_pallas_call_inside_ops_exempt(tmp_path):
+    fs = lint(tmp_path, _PALLAS_SNIPPET, name="ops/mykernel.py")
+    assert "GL012-stray-pallas-call" not in codes(fs)
+
+
+def test_gl012_from_import_flagged(tmp_path):
+    fs = lint(tmp_path, """
+        from jax.experimental.pallas import pallas_call
+        def fast(x):
+            return pallas_call(lambda ref, out: None, out_shape=x)(x)
+    """, name="models/layer.py")
+    assert "GL012-stray-pallas-call" in codes(fs)
 
 
 def test_gl002_graph_does_not_duplicate_nested_traced_helper(tmp_path):

@@ -95,8 +95,9 @@ def test_bench_budget_exhaustion_yields_skip_markers(bench_run):
     assert all(set(c) == {"name", "skipped"} for c in skipped)
     # every leg is accounted for: completed or explicitly skipped
     # (headline + prefetch A/B twin + zero1 A/B + trace A/B + chaos +
-    # elastic + tune + mpmd-pipe + noaccum + moe8 + moe8-cf1 + scan)
-    assert len(final["configs"]) == 12
+    # elastic + tune + mpmd-pipe + noaccum + moe8 + moe8-cf1 + scan +
+    # fusedupd)
+    assert len(final["configs"]) == 13
 
 
 def test_bench_artifact_is_valid_jsonl_of_all_legs(bench_run):
@@ -658,3 +659,104 @@ def test_get_batch_length_hook_feeds_samples(tmp_path):
         kvs = logger.getkvs()
     assert kvs["samples"] == 2 * (8 // 2)  # hook value, not step*batch
     assert loop.get_batch_length(next(loop.data)) == 4
+
+
+# ------------------------------------------- pallas fast-path legs (ISSUE 18)
+
+@pytest.fixture(scope="module")
+def decode_kernel_bench_run(tmp_path_factory):
+    """One bench subprocess filtered to the flash-decode kernel leg: the
+    same serve loop twice (decode_impl pallas vs xla) over one checkpoint,
+    with the kernel arm's schedule-derived HBM bytes landed next to the
+    XLA twin's cost-analysis bytes. BENCH_HISTORY is SET — the acceptance
+    covers the row riding the history file."""
+    tmp = tmp_path_factory.mktemp("decode_kernel_bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "600",
+        "BENCH_LEG_BUDGET_S": "600",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        "BENCH_ONLY": "gpt2-serve-decode-kernel",
+        "BENCH_HISTORY": str(tmp / "history.jsonl"),
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=700)
+    return proc, tmp / "legs.jsonl", tmp / "history.jsonl"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_decode_kernel_bench_leg_meets_acceptance(decode_kernel_bench_run):
+    """ISSUE 18 acceptance row: greedy tokens identical to the XLA paged
+    path, zero steady-window recompiles on BOTH arms, and the kernel's
+    per-token HBM bytes strictly below the gather path's."""
+    proc, artifact, history = decode_kernel_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {r["name"]: r for r in
+            (json.loads(line) for line in
+             artifact.read_text().strip().splitlines())}
+    row = rows["gpt2-serve-decode-kernel"]
+    assert "error" not in row and "skipped" not in row, row
+    assert row["tokens_identical_to_xla"] is True
+    assert row["recompile_count"] == 0
+    assert row["xla_recompile_count"] == 0
+    assert row["decode_hbm_bytes_per_token"] < \
+        row["xla_decode_bytes_per_token"]
+    assert 0 < row["hbm_bytes_ratio"] < 1
+    assert row["decode_tokens_per_s_per_chip"] > 0
+    hist = [json.loads(line) for line in
+            history.read_text().strip().splitlines()]
+    mine = [r for r in hist if r["name"] == "gpt2-serve-decode-kernel"]
+    assert len(mine) == 1 and mine[0].get("run_id")
+
+
+@pytest.fixture(scope="module")
+def fusedupd_bench_run(tmp_path_factory):
+    """One bench subprocess filtered to the fused-update twin of the
+    headline train leg: same model/step with --fused_update, the kernel's
+    read/write-census bytes landed next to the staged optax chain's
+    cost-analysis bytes."""
+    tmp = tmp_path_factory.mktemp("fusedupd_bench")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "600",
+        "BENCH_LEG_BUDGET_S": "600",
+        "BENCH_ARTIFACT": str(tmp / "legs.jsonl"),
+        "BENCH_CACHE_DIR": str(tmp / "cache"),
+        "BENCH_ONLY": "diffuseq-base-seq128-fusedupd",
+        "BENCH_HISTORY": str(tmp / "history.jsonl"),
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=700)
+    return proc, tmp / "legs.jsonl", tmp / "history.jsonl"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fusedupd_bench_leg_meets_acceptance(fusedupd_bench_run):
+    """ISSUE 18 acceptance row: the fused-update leg completes with real
+    throughput, its one-pass update bytes strictly below the staged
+    chain's, and the row rides the history file."""
+    proc, artifact, history = fusedupd_bench_run
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = {r["name"]: r for r in
+            (json.loads(line) for line in
+             artifact.read_text().strip().splitlines())}
+    row = rows["diffuseq-base-seq128-fusedupd"]
+    assert "error" not in row and "skipped" not in row, row
+    assert row["fused_update"] is True
+    assert row["tokens_per_sec_per_chip"] > 0
+    assert row["update_hbm_bytes_per_step"] < \
+        row["xla_update_bytes_per_step"]
+    assert 0 < row["update_bytes_ratio"] < 1
+    hist = [json.loads(line) for line in
+            history.read_text().strip().splitlines()]
+    mine = [r for r in hist if r["name"] == "diffuseq-base-seq128-fusedupd"]
+    assert len(mine) == 1 and mine[0].get("run_id")

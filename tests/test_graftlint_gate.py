@@ -64,6 +64,10 @@ GATED_PATHS = [
     # the autoscaler tests drive the fleet poll loop + scale decisions
     # and the elastic e2e ring — the same host-loop breeding ground
     os.path.join(ROOT, "tests", "test_autoscale.py"),
+    # the kernel parity tests drive DecodeServer host loops and TrainLoop
+    # outer steps (GL007) and sit next to the one sanctioned pallas_call
+    # home — exactly where a stray call outside ops/ would breed (GL012)
+    os.path.join(ROOT, "tests", "test_kernels.py"),
 ]
 
 
