@@ -59,9 +59,25 @@ from .core import (
     Finding,
     Module,
 )
+from . import dataflow
+from .dataflow import (  # re-exported for rules.py (one table owner)
+    BLOCKING_BUILTINS,
+    KEY_DERIVERS,
+    KEY_PARAM_PAT,
+    NP_BLOCKERS,
+    STEP_ATTRS,
+    SYNC_NP,
+    field_path,
+    is_key_param,
+    is_key_path,
+    path_prefix_of,
+    path_root,
+    path_suffix,
+    paths_conflict,
+)
 
-__all__ = ["CallGraph", "ModuleSummary", "module_name_for_path",
-           "summarize_module"]
+__all__ = ["CallGraph", "ModuleSummary", "SUMMARY_SCHEMA",
+           "module_name_for_path", "summarize_module"]
 
 _FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
 _LOOPS = (ast.For, ast.AsyncFor, ast.While)
@@ -112,6 +128,14 @@ def _absolutize(origin: str, modname: str, is_pkg: bool) -> str:
 
 # ======================================================== module summaries
 
+# Bump whenever the summary shape changes in a way from_dict's defaults
+# cannot paper over; a cached entry with any other value deserializes to
+# ValueError and the caller re-summarizes cold (cache.py's package salt
+# usually invalidates first — the schema is the belt to that suspender,
+# covering hand-edited or version-skewed cache files).
+SUMMARY_SCHEMA = 2
+
+
 @dataclasses.dataclass
 class ModuleSummary:
     """Everything the cross-module pass needs from one file, as plain
@@ -128,6 +152,7 @@ class ModuleSummary:
     local_donations: List[str]
     local_jitted: List[str]
     traced_refs: List[str]
+    schema: int = SUMMARY_SCHEMA
 
     @property
     def relname(self) -> str:
@@ -138,7 +163,26 @@ class ModuleSummary:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ModuleSummary":
-        return cls(**{f.name: d[f.name] for f in dataclasses.fields(cls)})
+        """Total over old-schema/garbled input in the sense that it
+        raises ValueError (never KeyError/TypeError surprises) — the
+        cache path treats that as a miss and re-summarizes cold."""
+        if not isinstance(d, dict):
+            raise ValueError(f"summary: expected dict, got {type(d)!r}")
+        if d.get("schema") != SUMMARY_SCHEMA:
+            raise ValueError(f"summary schema {d.get('schema')!r} != "
+                             f"{SUMMARY_SCHEMA}")
+        kwargs: Dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            if f.name in d:
+                kwargs[f.name] = d[f.name]
+            elif f.default is not dataclasses.MISSING:
+                kwargs[f.name] = f.default
+            else:
+                raise ValueError(f"summary missing field {f.name!r}")
+        try:
+            return cls(**kwargs)
+        except TypeError as e:  # pragma: no cover - defensive
+            raise ValueError(str(e))
 
 
 def _site(node: ast.AST, module: Module) -> dict:
@@ -183,64 +227,10 @@ def _scalar_hazard(arg: ast.AST) -> Optional[str]:
     return None
 
 
-# ---- semantic fact tables shared with the per-module rules (rules.py
-# imports these; callgraph must not import rules — that would cycle)
-
-SYNC_NP = {"asarray", "array", "sum", "mean", "std", "var", "max", "min",
-           "argmax", "argmin", "any", "all", "allclose", "isnan",
-           "isfinite", "isinf", "where", "concatenate", "stack", "dot",
-           "matmul", "prod", "abs", "clip", "sqrt", "exp", "log",
-           "float32", "float64", "int32", "int64"}
-NP_BLOCKERS = {"numpy.asarray", "numpy.array"}
-BLOCKING_BUILTINS = {"float", "int", "bool"}
-STEP_ATTRS = {"run_step", "forward_only", "train_step", "eval_step"}
-KEY_DERIVERS = {"PRNGKey", "key", "fold_in", "key_data", "wrap_key_data",
-                "clone", "key_impl"}
-KEY_PARAM_PAT = ("rng", "key", "prng", "seed_key")
-
-
-def is_key_param(name: str) -> bool:
-    low = name.lower()
-    return any(low == p or low.endswith("_" + p) or low.startswith(p + "_")
-               or low.rstrip("0123456789") == p for p in KEY_PARAM_PAT)
-
-
-def _sync_hit(module: Module, call: ast.Call,
-              params: Set[str]) -> Optional[dict]:
-    """A host-sync operation in ``call`` whose operand roots at one of
-    ``params`` — the only syncs a *caller* can cause (traced values flow
-    in through arguments), so the transitive findings stay proofs."""
-    func = call.func
-    fn = module.resolve(func)
-    if isinstance(func, ast.Attribute) and func.attr == "item" \
-            and not call.args:
-        root = _root_of(func.value)
-        if root in params:
-            return {"param": root, "desc": ".item()", "blocking": True}
-    if isinstance(func, ast.Name) and func.id in BLOCKING_BUILTINS \
-            and len(call.args) == 1 \
-            and not isinstance(call.args[0], ast.Constant):
-        root = _root_of(call.args[0])
-        if root in params:
-            return {"param": root, "desc": f"{func.id}()",
-                    "blocking": True}
-    if fn and fn.startswith("numpy.") and fn.split(".")[-1] in SYNC_NP:
-        for a in call.args:
-            root = _root_of(a)
-            if root in params:
-                return {"param": root, "desc": fn,
-                        "blocking": fn in NP_BLOCKERS}
-    if fn == "jax.device_get" and call.args:
-        root = _root_of(call.args[0])
-        if root in params:
-            return {"param": root, "desc": "jax.device_get",
-                    "blocking": False}
-    if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
-        root = _root_of(func.value)
-        if root in params:
-            return {"param": root, "desc": "block_until_ready",
-                    "blocking": False}
-    return None
+# (the semantic fact tables — SYNC_NP, KEY_PARAM_PAT, etc. — and the
+# host-sync shape detector live in dataflow.py now, re-exported above so
+# rules.py keeps one table owner; sync detection itself runs inside the
+# value-flow walk, over *derived* operands rather than parameter roots)
 
 
 def _loop_bound_names(loop: ast.AST) -> Set[str]:
@@ -261,7 +251,13 @@ def _loop_bound_names(loop: ast.AST) -> Set[str]:
             targets = [n.target]
         for t in targets:
             elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
-            out |= {e.id for e in elts if isinstance(e, ast.Name)}
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    out.add(e.id)
+                elif isinstance(e, (ast.Attribute, ast.Subscript)):
+                    p = field_path(e)
+                    if p is not None:
+                        out.add(p)
         stack.extend(ast.iter_child_nodes(n))
     return out
 
@@ -308,10 +304,19 @@ def _stmt_binds(s: ast.stmt) -> List[str]:
             if isinstance(e, ast.Name):
                 out.append(e.id)
             elif isinstance(e, (ast.Attribute, ast.Subscript)):
-                try:
-                    out.append(ast.unparse(e))
-                except Exception:  # pragma: no cover - defensive
-                    pass
+                # canonical path first (field-sensitive kills need the
+                # same spelling the arg descriptors use); a store with
+                # no stable path still kills by its base container
+                p = field_path(e)
+                if p is None and isinstance(e, ast.Subscript):
+                    p = field_path(e.value)
+                if p is not None:
+                    out.append(p)
+                else:
+                    try:
+                        out.append(ast.unparse(e))
+                    except Exception:  # pragma: no cover - defensive
+                        pass
     return out
 
 
@@ -341,8 +346,25 @@ def _terminates(stmts: List[ast.stmt]) -> bool:
         stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
 
 
+def _has_break(loop: ast.AST) -> bool:
+    """A ``break`` belonging to THIS loop (not a nested one) — decides
+    whether the loop-``else`` suite may be skipped."""
+    stack: List[ast.AST] = list(loop.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Break):
+            return True
+        if isinstance(n, _LOOPS) or isinstance(n, _FUNC_DEFS) \
+                or isinstance(n, (ast.ClassDef, ast.Lambda)):
+            continue  # a break inside these binds to them, not to us
+        stack.extend(c for c in ast.iter_child_nodes(n)
+                     if isinstance(c, (ast.stmt, ast.excepthandler)))
+    return False
+
+
 def _summarize_function(module: Module, qual: str, cls: Optional[str],
-                        node: ast.AST) -> dict:
+                        node: ast.AST,
+                        flow: "dataflow.FunctionFlow") -> dict:
     a = node.args
     params = [p.arg for p in a.posonlyargs + a.args]
     kwonly = [p.arg for p in a.kwonlyargs]
@@ -364,7 +386,9 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
         return loop_cache[key]
 
     calls: List[dict] = []
-    syncs: List[dict] = []
+    # host-sync sites from the value-flow walk: operands *derived* from
+    # parameters (gap 1), not merely rooted at them
+    syncs: List[dict] = [dict(s) for s in flow.syncs]
     candidates: Set[str] = set()
     pending: List[Tuple[ast.stmt, dict]] = []
 
@@ -384,34 +408,40 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
                 member = fn.rsplit(".", 1)[1]
                 # jax.random.* consume the KEY argument only — the
                 # first positional (or key=); counting shape/count args
-                # would poison the key-consumption fixpoint
-                key_args = [a for a in call.args[:1]
-                            if isinstance(a, ast.Name)]
-                key_args += [k.value for k in call.keywords
-                             if k.arg == "key"
-                             and isinstance(k.value, ast.Name)]
-                for arg in key_args:
+                # would poison the key-consumption fixpoint. The key may
+                # be a container field (state['rng'], self.key): any
+                # canonical path works, not just a bare name.
+                key_nodes = list(call.args[:1])
+                key_nodes += [k.value for k in call.keywords
+                              if k.arg == "key"]
+                for arg in key_nodes:
+                    kp = field_path(arg)
+                    if kp is None:
+                        continue
                     if member == "split":
                         ev["ksplits"].append(
-                            {"name": arg.id, **_site(call, module)})
+                            {"name": kp, **_site(call, module)})
                     elif member not in KEY_DERIVERS:
                         ev["kuses"].append(
-                            {"name": arg.id, "desc": fn,
+                            {"name": kp, "desc": fn,
                              **_site(call, module)})
-            hit = _sync_hit(module, call, pset)
-            if hit:
-                syncs.append({**hit, **_site(call, module)})
             try:
                 callee = ast.unparse(call.func)
             except Exception:  # pragma: no cover - defensive
                 continue
-            if not isinstance(call.func, (ast.Name, ast.Attribute)):
+            pt = flow.candidates.get(id(call))
+            if not isinstance(call.func, (ast.Name, ast.Attribute)) \
+                    and not pt:
                 continue  # calls of call results etc.: unresolvable
 
             def desc(arg: ast.AST) -> dict:
                 d: dict = {}
-                if isinstance(arg, (ast.Name, ast.Attribute)):
-                    try:
+                path = field_path(arg)
+                if path is not None:
+                    d["name"] = path
+                    d["suffix"] = dataflow.path_suffix(path)
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    try:  # pragma: no cover - field_path covers these
                         d["name"] = ast.unparse(arg)
                     except Exception:  # pragma: no cover - defensive
                         pass
@@ -445,6 +475,12 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
                 "loop_rebound": sorted(loop_bound) if loop is not None
                 else [],
             }
+            if pt:
+                # bounded points-to candidates for a callee the static
+                # symbol table cannot resolve (callable in a container/
+                # dataclass field); the graph pass treats a fact as
+                # proven only when every candidate carries it
+                site["pt"] = list(pt)
             for d in site["pos"] + list(site["kw"].values()):
                 if d.get("root"):
                     candidates.add(d["root"])
@@ -456,18 +492,21 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
             vfn = module.resolve(s.value.func)
             if vfn and vfn.startswith("jax.random.") \
                     and vfn.rsplit(".", 1)[1] in (KEY_DERIVERS | {"split"}):
-                ev["fresh"] = [b for b in binds if "." not in b]
+                ev["fresh"] = list(binds)
         pending.append((s, ev))
         return ev
 
     def build(body: List[ast.stmt], loop: Optional[ast.AST]
               ) -> List[dict]:
-        """Statement-event tree in source order. ``if`` branches become
+        """Statement-event tree in source order. ``if`` arms, ``try``
+        body-vs-handlers, and may-skip loop-``else`` suites become
         nested {"branches": [{"events", "terminates"}, ...]} entries so
         the replays can give each arm its own state copy and drop
         terminated arms — a consumption inside an early-``return`` body
-        must not leak into the fall-through path (the GL001 semantics,
-        kept at the summary level)."""
+        must not leak into the fall-through path, and a retry pattern
+        (consume in ``try``, consume again in ``except``) must not
+        count as a double consumption (the GL001 semantics, kept at the
+        summary level)."""
         out: List[dict] = []
         for s in body:
             if isinstance(s, _FUNC_DEFS) or isinstance(s, ast.ClassDef):
@@ -485,12 +524,35 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
                     out.append({"branches": branches})
             elif isinstance(s, _LOOPS):
                 out.extend(build(s.body, s))
-                out.extend(build(s.orelse, loop))
+                if s.orelse:
+                    if _has_break(s):
+                        # a break skips the else suite: one arm runs it,
+                        # one falls through — replay both
+                        out.append({"branches": [
+                            {"events": build(s.orelse, loop),
+                             "terminates": _terminates(s.orelse)},
+                            {"events": [], "terminates": False},
+                        ]})
+                    else:
+                        # no break: the else suite always runs — inline
+                        out.extend(build(s.orelse, loop))
+            elif isinstance(s, ast.Try) \
+                    or s.__class__.__name__ == "TryStar":
+                # body+else is one arm, each handler another, all
+                # replayed from the pre-try state; finally is inline
+                # (it always runs, after whichever arm)
+                arms = [{"events": (build(s.body, loop)
+                                    + build(s.orelse, loop)),
+                         "terminates": _terminates(s.orelse or s.body)}]
+                for h in s.handlers:
+                    arms.append({"events": build(h.body, loop),
+                                 "terminates": _terminates(h.body)})
+                if any(br["events"] or br["terminates"] for br in arms):
+                    out.append({"branches": arms})
+                out.extend(build(s.finalbody, loop))
             else:
                 for field in ("body", "orelse", "finalbody"):
                     out.extend(build(getattr(s, field, []) or [], loop))
-                for h in getattr(s, "handlers", []) or []:
-                    out.extend(build(h.body, loop))
         return out
 
     events = build(node.body, None)
@@ -501,7 +563,7 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
         if not candidates:
             break
         for n in _shallow(s):
-            if not isinstance(n, (ast.Name, ast.Attribute)):
+            if not isinstance(n, (ast.Name, ast.Attribute, ast.Subscript)):
                 continue
             if not isinstance(getattr(n, "ctx", None), ast.Load):
                 continue
@@ -514,10 +576,16 @@ def _summarize_function(module: Module, qual: str, cls: Optional[str],
             root = _root_of(n)
             if root not in candidates:
                 continue
-            try:
-                text = ast.unparse(n)
-            except Exception:  # pragma: no cover - defensive
-                continue
+            text = field_path(n)
+            if text is None and isinstance(n, ast.Subscript):
+                # dynamic index: any element may be the dead one, so
+                # the read touches the whole container
+                text = field_path(n.value)
+            if text is None:
+                try:
+                    text = ast.unparse(n)
+                except Exception:  # pragma: no cover - defensive
+                    continue
             ev["reads"].append({"text": text, **_site(n, module)})
 
     def prune(evs: List[dict]) -> List[dict]:
@@ -563,8 +631,13 @@ def summarize_module(module: Module) -> ModuleSummary:
                for k, v in module.imports.alias.items()}
     funcs: Dict[str, dict] = {}
     classes: Dict[str, List[str]] = {}
+    # module-level + per-class points-to maps feed every function's
+    # value-flow walk (callables in module dicts / dataclass fields)
+    mod_penv, class_pt, class_names = dataflow.module_maps(module)
     for qual, cls, node in _iter_funcs(module.tree):
-        funcs[qual] = _summarize_function(module, qual, cls, node)
+        flow = dataflow.analyze_function(module, node, cls, class_pt,
+                                         mod_penv, class_names)
+        funcs[qual] = _summarize_function(module, qual, cls, node, flow)
     for node in ast.walk(module.tree):
         if isinstance(node, ast.ClassDef):
             classes[node.name] = [b.name for b in node.body
@@ -757,8 +830,15 @@ class CallGraph:
     def _build(self) -> None:
         if self._built:
             return
-        # resolved call targets, aligned with each function's calls list
+        # resolved call targets, aligned with each function's calls
+        # list; ``targets`` holds the UNIQUE resolution (or _UNKNOWN),
+        # ``alt_targets`` the full bounded candidate list from the
+        # points-to pass. A unique target (static or single-candidate
+        # points-to) feeds everything including the traced closure; a
+        # multi-candidate set feeds only the must-facts (a fact proven
+        # for EVERY candidate), and an unresolvable one feeds nothing.
         self.targets: Dict[Tuple[str, str], List[Target]] = {}
+        self.alt_targets: Dict[Tuple[str, str], List[List[Target]]] = {}
         # call edges into each function: fid -> [(caller fid, site, target)]
         self.edges_in: Dict[Tuple[str, str],
                             List[Tuple[Tuple[str, str], dict,
@@ -767,15 +847,32 @@ class CallGraph:
             for qual, fs in mod.funcs.items():
                 fid = (path, qual)
                 resolved: List[Target] = []
+                alts: List[List[Target]] = []
                 for site in fs["calls"]:
                     t = self.resolve(mod, site["callee"],
                                      scope_qual=qual, cls=fs.get("cls"))
-                    resolved.append(t)
+                    cands: List[Target] = [t] if t.kind != "unknown" \
+                        else []
+                    if not cands and site.get("pt"):
+                        pt = [self.resolve(mod, c, scope_qual=qual,
+                                           cls=fs.get("cls"))
+                              for c in site["pt"]]
+                        # all-or-nothing: one unresolvable candidate
+                        # poisons the set (the callable could be it)
+                        if pt and all(c.kind != "unknown"
+                                      and c.fid is not None
+                                      for c in pt):
+                            cands = pt
+                    if len(cands) == 1:
+                        t = cands[0]
+                    resolved.append(t if len(cands) == 1 else _UNKNOWN)
+                    alts.append(cands)
                     tfid = t.fid
-                    if tfid is not None:
+                    if len(cands) == 1 and tfid is not None:
                         self.edges_in.setdefault(tfid, []).append(
                             (fid, site, t))
                 self.targets[fid] = resolved
+                self.alt_targets[fid] = alts
         self._traced = self._traced_closure()
         self._blocking = self._param_fixpoint(self._blocking_seeds())
         self._keys = self._param_fixpoint(self._key_seeds())
@@ -825,37 +922,66 @@ class CallGraph:
     # generic backward (callee -> caller) parameter-taint fixpoint
     def _param_fixpoint(self, seeds: Dict[Tuple[str, str, str], dict]
                         ) -> Dict[Tuple[str, str, str], dict]:
-        """seeds: (path, qual, param) -> {"desc", "line", "snippet"}
-        (terminal facts). Propagates through call sites whose argument
-        roots at a caller parameter; each propagated entry records its
-        next hop so messages can cite the chain. Monotone set growth +
-        finite universe => cycles/recursion converge."""
+        """seeds: (path, qual, param) -> {"desc", "line", "snippet",
+        optional "field"} (terminal facts). Propagates through call
+        sites whose argument roots at a caller parameter; each
+        propagated entry records its next hop so messages can cite the
+        chain, and composes field suffixes (a fact on ``state['opt']``
+        passed as ``cfg.state`` becomes a fact on ``cfg`` with field
+        ``.state['opt']``). Multi-candidate (points-to) sites propagate
+        only facts EVERY candidate proves, with an agreeing field.
+        Monotone set growth + finite universe => cycles/recursion
+        converge."""
         facts = dict(seeds)
         changed = True
         while changed:
             changed = False
-            for fid, resolved in self.targets.items():
+            for fid, alts in self.alt_targets.items():
                 fs = self._func(fid)
                 pset = set(fs["params"]) | set(fs["kwonly"])
-                for site, target in zip(fs["calls"], resolved):
-                    mapping = self.map_args(site, target)
-                    if not mapping:
+                for site, cands in zip(fs["calls"], alts):
+                    if not cands:
                         continue
-                    tfid = target.fid
-                    if tfid is None:
+                    maps = []
+                    for t in cands:
+                        m = self.map_args(site, t)
+                        if m is None or t.fid is None:
+                            maps = None
+                            break
+                        maps.append((t, m))
+                    if not maps:
                         continue
-                    for arg, pname in mapping:
+                    base_t, base_m = maps[0]
+                    for arg, _pname0 in base_m:
                         root = arg.get("root")
                         if root not in pset:
-                            continue
-                        down = facts.get((tfid[0], tfid[1], pname))
-                        if down is None:
                             continue
                         key = (fid[0], fid[1], root)
                         if key in facts:
                             continue
-                        facts[key] = {"via": site, "via_label":
-                                      target.label(), "next": down}
+                        down = None
+                        fields = set()
+                        for t, m in maps:
+                            pname = next((p for a, p in m if a is arg),
+                                         None)
+                            f = facts.get((t.fid[0], t.fid[1], pname)) \
+                                if pname is not None else None
+                            if f is None:
+                                down = None
+                                break
+                            down = down or f
+                            fields.add(f.get("field", ""))
+                        if down is None or len(fields) != 1:
+                            continue  # unproven on some candidate, or
+                            # the candidates disagree on WHICH sub-path
+                            # the fact touches: widen to silence
+                        facts[key] = {
+                            "via": site, "via_label": base_t.label()
+                            + (f" (+{len(maps) - 1} candidate(s))"
+                               if len(maps) > 1 else ""),
+                            "next": down,
+                            "field": arg.get("suffix", "")
+                            + fields.pop()}
                         changed = True
         return facts
 
@@ -865,7 +991,10 @@ class CallGraph:
             for qual, fs in mod.funcs.items():
                 for s in fs["syncs"]:
                     if s.get("blocking"):
-                        seeds.setdefault((path, qual, s["param"]), s)
+                        # a sync operand may derive from SEVERAL params
+                        # (loss = state.loss + aux): each one blocks
+                        for p in s.get("params") or [s["param"]]:
+                            seeds.setdefault((path, qual, p), s)
         return seeds
 
     @staticmethod
@@ -888,12 +1017,17 @@ class CallGraph:
                 pset = set(fs["params"]) | set(fs["kwonly"])
                 for ev in self._iter_stmt_events(fs["events"]):
                     for u in ev["kuses"] + ev["ksplits"]:
-                        if u["name"] in pset:
+                        # the consumed key may be a field of a param
+                        # (state['rng']): seed the param with the field
+                        # suffix so callers track the right sub-path
+                        root = path_root(u["name"])
+                        if root in pset:
                             seeds.setdefault(
-                                (path, qual, u["name"]),
+                                (path, qual, root),
                                 {"desc": u.get("desc", "jax.random.split"),
                                  "line": u["line"],
-                                 "snippet": u["snippet"]})
+                                 "snippet": u["snippet"],
+                                 "field": path_suffix(u["name"])})
         return seeds
 
     def _donation_seeds(self) -> Dict[Tuple[str, str, str], dict]:
@@ -914,12 +1048,17 @@ class CallGraph:
                         continue
                     arg = site["pos"][cp]
                     root = arg.get("root")
-                    if arg.get("simple") and root in pset:
+                    # a donated CONTAINER FIELD (state['params']) seeds
+                    # the param with that field suffix — callers learn
+                    # exactly which sub-tree dies (gap 2)
+                    if root in pset and (arg.get("simple")
+                                         or arg.get("suffix")):
                         seeds.setdefault(
                             (fid[0], fid[1], root),
                             {"desc": f"donated to {target.label()}",
                              "line": site["line"],
-                             "snippet": site["snippet"]})
+                             "snippet": site["snippet"],
+                             "field": arg.get("suffix", "")})
         return seeds
 
     # ------------------------------------------------------------- mapping
@@ -946,25 +1085,45 @@ class CallGraph:
                 out.append((arg, k))
         return out
 
-    def _donated_args(self, site: dict, target: Target
-                      ) -> List[Tuple[dict, int]]:
-        """(arg descriptor, underlying position) pairs this call site
-        donates — directly via a jit binding's donate_argnums, or through
-        a callee that (transitively) donates the mapped parameter."""
-        out: List[Tuple[dict, int]] = []
-        if target.kind == "jit" and target.jit \
+    def _donated_args(self, site: dict, cands: List[Target]
+                      ) -> List[Tuple[dict, str]]:
+        """(arg descriptor, donated field suffix) pairs this call site
+        donates — directly via a jit binding's donate_argnums, or
+        through a callee that (transitively) donates the mapped
+        parameter. With several points-to candidates the donation must
+        be proven for EVERY candidate, on an agreeing field."""
+        if not cands:
+            return []
+        target = cands[0]
+        if len(cands) == 1 and target.kind == "jit" and target.jit \
                 and target.jit.get("donate"):
+            out: List[Tuple[dict, str]] = []
             for d in target.jit["donate"]:
                 cp = int(d) - target.offset
                 if 0 <= cp < len(site["pos"]):
-                    out.append((site["pos"][cp], int(d)))
-        elif target.kind == "func":
-            mapping = self.map_args(site, target)
-            if mapping:
-                tfid = target.fid
-                for arg, pname in mapping:
-                    if (tfid[0], tfid[1], pname) in self._donating:
-                        out.append((arg, -1))
+                    out.append((site["pos"][cp], ""))
+            return out
+        if not all(t.kind == "func" for t in cands):
+            return []
+        maps = []
+        for t in cands:
+            m = self.map_args(site, t)
+            if m is None or t.fid is None:
+                return []
+            maps.append((t, m))
+        out = []
+        for arg, _pname0 in maps[0][1]:
+            fields = set()
+            for t, m in maps:
+                pname = next((p for a, p in m if a is arg), None)
+                fact = self._donating.get((t.fid[0], t.fid[1], pname)) \
+                    if pname is not None else None
+                if fact is None:
+                    fields = None
+                    break
+                fields.add(fact.get("field", ""))
+            if fields and len(fields) == 1:
+                out.append((arg, fields.pop()))
         return out
 
     # ------------------------------------------------------------ messages
@@ -1029,16 +1188,25 @@ class CallGraph:
                             f"{cmod.relname}:{caller[1]} "
                             f"(line {site['line']})")
                 for s in fs["syncs"]:
-                    who = hot.get(s["param"])
-                    if who is None:
+                    # the operand may derive from several params; ANY
+                    # of them receiving a traced value makes the sync
+                    # real (derivation is value-preserving)
+                    hit = next((p for p in (s.get("params")
+                                            or [s["param"]])
+                                if p in hot), None)
+                    if hit is None:
                         continue
+                    who = hot[hit]
                     key = (path, qual, s["line"], s["col"])
                     if key in emitted:
                         continue
                     emitted.add(key)
+                    what = (f"a value derived from parameter '{hit}'"
+                            if s.get("derived")
+                            else f"parameter '{hit}'")
                     yield self._finding(
                         rule, path, s,
-                        f"{s['desc']} on parameter '{s['param']}' of "
+                        f"{s['desc']} on {what} of "
                         f"'{qual}' — this helper is reached from traced "
                         f"code (called by {who}), so the sync happens "
                         "inside jit tracing; hoist the conversion out "
@@ -1051,24 +1219,45 @@ class CallGraph:
         for path, mod in self.by_path.items():
             for qual, fs in mod.funcs.items():
                 fid = (path, qual)
-                for site, target in zip(fs["calls"], self.targets[fid]):
-                    if not site["in_loop"] or target.kind != "func":
+                for site, cands in zip(fs["calls"],
+                                       self.alt_targets[fid]):
+                    if not site["in_loop"] or not cands \
+                            or not all(t.kind == "func" for t in cands):
                         continue
-                    mapping = self.map_args(site, target)
-                    if not mapping:
+                    maps = []
+                    for t in cands:
+                        m = self.map_args(site, t)
+                        if m is None or t.fid is None:
+                            maps = None
+                            break
+                        maps.append((t, m))
+                    if not maps:
                         continue
-                    tfid = target.fid
-                    for arg, pname in mapping:
-                        if not arg.get("step"):
+                    hit = False
+                    for arg, _p in maps[0][1]:
+                        if hit or not arg.get("step"):
                             continue
-                        fact = self._blocking.get(
-                            (tfid[0], tfid[1], pname))
+                        fact = None
+                        pname = None
+                        for t, m in maps:
+                            pname = next((p for a, p in m if a is arg),
+                                         None)
+                            f = self._blocking.get(
+                                (t.fid[0], t.fid[1], pname)) \
+                                if pname is not None else None
+                            if f is None:
+                                fact = None
+                                break
+                            fact = fact or f
                         if fact is None:
                             continue
                         term = self._terminal(fact)
+                        label = maps[0][0].label() + (
+                            f" (+{len(maps) - 1} candidate(s), all "
+                            "blocking)" if len(maps) > 1 else "")
                         yield self._finding(
                             rule, path, site,
-                            f"'{target.label()}' blocks on its "
+                            f"'{label}' blocks on its "
                             f"'{pname}' argument "
                             f"({term.get('desc', '?')} at line "
                             f"{term.get('line', '?')}) — calling it on "
@@ -1076,7 +1265,7 @@ class CallGraph:
                             "step host sync that defeats async "
                             "dispatch; pass a device value through or "
                             "fetch once outside the loop")
-                        break  # one finding per call site
+                        hit = True  # one finding per call site
 
     def iter_cross_module_donations(self, rule: Any) -> Iterator[Finding]:
         """GL003 upgrade: replay each function's statement events; a
@@ -1092,11 +1281,11 @@ class CallGraph:
             for qual, fs in mod.funcs.items():
                 fid = (path, qual)
                 yield from self._replay_donations(
-                    rule, path, fs, self.targets[fid], local,
+                    rule, path, fs, self.alt_targets[fid], local,
                     fs["events"], {})
 
     def _replay_donations(self, rule: Any, path: str, fs: dict,
-                          resolved: List[Target], local: Set[str],
+                          alts: List[List[Target]], local: Set[str],
                           events: List[dict],
                           armed: Dict[str, str]) -> Iterator[Finding]:
         for ev in events:
@@ -1105,7 +1294,7 @@ class CallGraph:
                 for br in ev["branches"]:
                     st = dict(armed)
                     yield from self._replay_donations(
-                        rule, path, fs, resolved, local,
+                        rule, path, fs, alts, local,
                         br["events"], st)
                     if not br["terminates"]:
                         survivors.append(st)
@@ -1116,7 +1305,12 @@ class CallGraph:
                 continue
             for r in ev["reads"]:
                 for d in sorted(armed):
-                    if r["text"] == d or r["text"].startswith(d + "."):
+                    # component-wise both ways: reading the whole
+                    # container touches its dead field, reading the
+                    # dead field is the r6 shape itself; reading a
+                    # SIBLING field (state['opt'] vs state['params'])
+                    # conflicts with neither
+                    if paths_conflict(r["text"], d):
                         yield self._finding(
                             rule, path, r,
                             f"'{d}' was {armed[d]} — its buffer "
@@ -1127,18 +1321,22 @@ class CallGraph:
                         break
             for idx in ev["calls"]:
                 site = fs["calls"][idx]
-                target = resolved[idx]
+                cands = alts[idx]
                 if site["callee"] in local:
                     continue  # the local rule owns this donor
-                for arg, _pos in self._donated_args(site, target):
+                for arg, extra in self._donated_args(site, cands):
                     name = arg.get("name")
-                    if name and name not in ev["binds"]:
-                        armed[name] = (f"donated to "
-                                       f"'{target.label()}' at "
+                    if not name:
+                        continue
+                    full = name + extra
+                    if not any(path_prefix_of(b, full)
+                               for b in ev["binds"]):
+                        armed[full] = (f"donated to "
+                                       f"'{cands[0].label()}' at "
                                        f"line {site['line']}")
             for b in ev["binds"]:
                 for d in list(armed):
-                    if d == b or d.startswith(b + "."):
+                    if path_prefix_of(b, d):
                         armed.pop(d)
 
     def iter_distant_static_hazards(self, rule: Any) -> Iterator[Finding]:
@@ -1196,33 +1394,59 @@ class CallGraph:
             "static at the jax.jit site or derive it inside the jit)")
 
     def iter_cross_module_key_reuse(self, rule: Any) -> Iterator[Finding]:
-        """GL011: replay each function's events tracking its key-named
-        parameters; a key consumed twice — where at least one consumer
-        is a (transitively proven) key-consuming callee — or consumed
-        after a split, or consumed every loop iteration by a proven
-        consumer without rebinding, is correlated randomness the local
-        GL001 could not see."""
+        """GL011: replay each function's events tracking key-shaped
+        PATHS — key-named parameters plus any parameter-rooted
+        container field whose last component is key-named
+        (``state['rng']``, ``self._key``); a key consumed twice — where
+        at least one consumer is a (transitively proven) key-consuming
+        callee — or consumed after a split, or consumed every loop
+        iteration by a proven consumer without rebinding, is correlated
+        randomness the local GL001 could not see."""
         self._build()
         for path, mod in self.by_path.items():
             for qual, fs in mod.funcs.items():
                 fid = (path, qual)
-                keys = [p for p in fs["params"] + fs["kwonly"]
-                        if is_key_param(p)]
-                if not keys:
-                    continue
                 state: Dict[str, dict] = {
-                    k: {"uses": [], "split": False} for k in keys}
+                    p: {"uses": [], "split": False}
+                    for p in fs["params"] + fs["kwonly"]
+                    if is_key_param(p)}
+                if not state and not any(
+                        is_key_path(u["name"]) for ev in
+                        self._iter_stmt_events(fs["events"])
+                        for u in ev["kuses"] + ev["ksplits"]) \
+                        and not any(
+                        is_key_path(a["name"])
+                        for site in fs["calls"]
+                        for a in (list(site["pos"])
+                                  + list(site["kw"].values()))
+                        if a.get("name")):
+                    continue
                 yield from self._replay_keys(
-                    rule, path, fs, self.targets[fid], fs["events"],
-                    state)
+                    rule, path, fs, self.alt_targets[fid],
+                    fs["events"], state)
 
     def _replay_keys(self, rule: Any, path: str, fs: dict,
-                     resolved: List[Target], events: List[dict],
+                     alts: List[List[Target]], events: List[dict],
                      state: Dict[str, dict]) -> Iterator[Finding]:
+        pset = set(fs["params"]) | set(fs["kwonly"])
+
+        def tracked(name: str) -> Optional[dict]:
+            """The state entry for a consumed path, lazily starting to
+            track a parameter-rooted key-shaped field on first touch
+            (its pre-call history is unknown — honest zero)."""
+            st = state.get(name)
+            if st is not None:
+                return st
+            root = path_root(name)
+            if name != root and root in pset and is_key_path(name):
+                st = {"uses": [], "split": False}
+                state[name] = st
+                return st
+            return None
 
         def consume(name: str, kind: str, label: str,
                     site: dict) -> Optional[Finding]:
-            st = state.get(name)
+            st = tracked(name)
             if st is None:
                 return None
             finding = None
@@ -1257,18 +1481,20 @@ class CallGraph:
                                "split": v["split"]}
                            for k, v in state.items()}
                     yield from self._replay_keys(
-                        rule, path, fs, resolved, br["events"], st2)
+                        rule, path, fs, alts, br["events"], st2)
                     if not br["terminates"]:
                         survivors.append(st2)
                 if survivors:
                     # GL001 merge semantics: a key survives only if every
                     # surviving arm still tracks it; uses = the heaviest
                     # arm's, split = any arm's
-                    for name in list(state):
+                    names = set(state)
+                    names.update(*survivors)
+                    for name in names:
                         alive = [s[name] for s in survivors
                                  if name in s]
                         if len(alive) < len(survivors):
-                            state.pop(name)
+                            state.pop(name, None)
                             continue
                         best = max(alive, key=lambda s: len(s["uses"]))
                         state[name] = {
@@ -1285,7 +1511,7 @@ class CallGraph:
                 if f is not None:
                     yield f
             for u in ev["ksplits"]:
-                st = state.get(u["name"])
+                st = tracked(u["name"])
                 if st is None:
                     continue
                 if any(k2 == "callee" for k2, _l in st["uses"]):
@@ -1301,40 +1527,69 @@ class CallGraph:
                 st["split"] = True
             for idx in ev["calls"]:
                 site = fs["calls"][idx]
-                target = resolved[idx]
-                if target.kind not in ("func", "jit"):
+                cands = alts[idx]
+                if not cands or not all(t.kind in ("func", "jit")
+                                        for t in cands):
                     continue
-                mapping = self.map_args(site, target)
-                if not mapping:
+                maps = []
+                for t in cands:
+                    m = self.map_args(site, t)
+                    if m is None or t.fid is None:
+                        maps = None
+                        break
+                    maps.append((t, m))
+                if not maps:
                     continue
-                tfid = target.fid
-                for arg, pname in mapping:
-                    name = arg.get("root")
-                    if not arg.get("simple") or name not in state:
+                for arg, _pname0 in maps[0][1]:
+                    name = arg.get("name")
+                    if not name:
                         continue
-                    fact = self._keys.get((tfid[0], tfid[1], pname))
-                    if fact is None:
+                    # the fact must hold on EVERY candidate, with an
+                    # agreeing consumed field (gap 4: dispatch through
+                    # a container/dataclass callable stays a proof)
+                    fact = None
+                    fields = set()
+                    for t, m in maps:
+                        pname = next((p for a, p in m if a is arg),
+                                     None)
+                        f = self._keys.get((t.fid[0], t.fid[1], pname)) \
+                            if pname is not None else None
+                        if f is None:
+                            fact = None
+                            break
+                        fact = fact or f
+                        fields.add(f.get("field", ""))
+                    if fact is None or len(fields) != 1:
+                        continue
+                    # which key path the callee actually consumes:
+                    # the argument's path plus the proven field (gap
+                    # 3: state['rng'] passed whole, consumed inside)
+                    consumed = name + fields.pop()
+                    if consumed not in state \
+                            and tracked(consumed) is None:
                         continue
                     term = self._terminal(fact)
-                    label = (f"'{target.label()}' "
+                    label = (f"'{maps[0][0].label()}' "
                              f"({term.get('desc', 'jax.random')}"
                              f" at line {term.get('line', '?')})")
-                    if site["in_loop"] \
-                            and name not in site["loop_rebound"]:
+                    if site["in_loop"] and not any(
+                            paths_conflict(consumed, r)
+                            for r in site["loop_rebound"]):
                         yield self._finding(
                             rule, path, site,
-                            f"key '{name}' from outside the "
+                            f"key '{consumed}' from outside the "
                             f"loop is consumed by {label} every "
                             "iteration without rebinding — same "
                             "randomness each pass; fold_in the "
                             "loop index")
-                        state[name] = {"uses": [], "split": False}
+                        state[consumed] = {"uses": [], "split": False}
                         continue
-                    f = consume(name, "callee", label, site)
+                    f = consume(consumed, "callee", label, site)
                     if f is not None:
                         yield f
             for b in ev["binds"]:
-                # rebound to a non-key: stop tracking (fresh-key
-                # rebinds were reset above instead)
-                if b in state and b not in ev["fresh"]:
-                    state.pop(b)
+                # rebound to a non-key: stop tracking every path the
+                # bind covers (fresh-key rebinds were reset above)
+                for p in list(state):
+                    if path_prefix_of(b, p) and b not in ev["fresh"]:
+                        state.pop(p)

@@ -18,7 +18,7 @@ from typing import List, Optional
 
 from .baseline import BASELINE_NAME, Baseline, discover_baseline, path_tail
 from .cache import CACHE_NAME, AnalysisCache
-from .core import all_rules, iter_py_files, run_paths
+from .core import _assign_indices, all_rules, iter_py_files, run_paths
 from . import rules as _rules  # noqa: F401  (register the catalog)
 
 
@@ -58,7 +58,44 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-cache", action="store_true",
                    help="disable the content-hash parse/summary cache "
                         f"({CACHE_NAME} beside the baseline)")
+    p.add_argument("--runtime-evidence", default="", metavar="RUN_DIR",
+                   help="cross-reference runtime sanitizer evidence: "
+                        "load sanitize_report.json sidecars (RUN_DIR "
+                        "itself, a direct file path, or any depth below "
+                        "RUN_DIR) and report each violation the static "
+                        "pass did NOT flag at the same file+line as a "
+                        "GL013 coverage-gap finding")
     return p
+
+
+def _load_sanitize_reports(root: str) -> List[tuple]:
+    """(path, report dict) for every readable sanitize_report.json at or
+    under ``root`` (which may also name the file directly). Garbled
+    sidecars are skipped with a note — evidence is best-effort by
+    design, and a half-written report must not kill the lint."""
+    from .rules import SANITIZE_REPORT_NAME
+
+    candidates: List[str] = []
+    if os.path.isfile(root):
+        candidates.append(root)
+    else:
+        for dirpath, _dirs, files in os.walk(root):
+            if SANITIZE_REPORT_NAME in files:
+                candidates.append(
+                    os.path.join(dirpath, SANITIZE_REPORT_NAME))
+    out: List[tuple] = []
+    for path in sorted(candidates):
+        try:
+            with open(path) as f:
+                report = json.load(f)
+            if not isinstance(report.get("violations"), list):
+                raise ValueError("no violations list")
+        except (OSError, ValueError) as e:
+            print(f"# runtime-evidence: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        out.append((path, report))
+    return out
 
 
 def _github_lines(findings) -> List[str]:
@@ -107,6 +144,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cache is not None:
         print(f"# cache: {cache.hits} hit(s), {cache.misses} miss(es)",
               file=sys.stderr)
+    if args.runtime_evidence:
+        reports = _load_sanitize_reports(args.runtime_evidence)
+        if not reports:
+            print(f"error: no sanitize_report.json found under "
+                  f"{args.runtime_evidence!r} (run with --sanitize to "
+                  f"produce one)", file=sys.stderr)
+            return 2
+        violations = [v for _p, r in reports
+                      for v in r.get("violations", [])]
+        gaps = _rules.runtime_evidence_findings(violations, findings)
+        # re-index the combined list: GL013 fingerprints must be as
+        # stable as everyone else's so they can be baselined/audited
+        findings = _assign_indices(findings + gaps)
+        print(f"# runtime-evidence: {len(reports)} report(s), "
+              f"{len(violations)} violation(s), {len(gaps)} coverage "
+              f"gap(s)", file=sys.stderr)
     if n_files == 0:
         # a gate that lints zero files vouches for nothing — a typo'd CI
         # path must fail loudly, not report OK

@@ -425,17 +425,26 @@ def run_paths(paths: Iterable[str],
         sha = hashlib.sha1(raw).hexdigest()
         entry = cache.get(path, sha, codes) if cache is not None else None
         if entry is not None:
-            summary = callgraph.ModuleSummary.from_dict(entry["summary"])
-            # the entry may have been written under a different path
-            # SPELLING (relative CLI run vs absolute gate run); re-key to
-            # this run's spelling so graph fids and report paths agree
-            summary.path = path
-            summaries[path] = summary
-            findings.extend(Finding(**{**f, "path": path})
-                            for f in entry["findings"]
-                            if f["rule"] in codes
-                            or f["rule"] == "GL000-parse-error")
-            continue
+            try:
+                summary = callgraph.ModuleSummary.from_dict(
+                    entry["summary"])
+                cached = [Finding(**{**f, "path": path})
+                          for f in entry["findings"]
+                          if f["rule"] in codes
+                          or f["rule"] == "GL000-parse-error"]
+            except (KeyError, TypeError, ValueError):
+                # old-schema or garbled entry: degrade to a cold
+                # re-summarize below, never a crash
+                entry = None
+            else:
+                # the entry may have been written under a different path
+                # SPELLING (relative CLI run vs absolute gate run);
+                # re-key to this run's spelling so graph fids and report
+                # paths agree
+                summary.path = path
+                summaries[path] = summary
+                findings.extend(cached)
+                continue
         try:
             module = Module(path, raw.decode("utf-8"))
         except (SyntaxError, UnicodeDecodeError, ValueError) as e:

@@ -1,0 +1,875 @@
+"""Intraprocedural value-flow for graftlint (ROADMAP item 7 closure).
+
+The r17 call-graph pass proved facts about values *rooted at
+parameters*: ``float(x)`` where ``x`` is a parameter, donation of a
+bare name, a key passed as the first positional. Everything one hop of
+local dataflow away — ``loss = state.loss * 2; float(loss)``, donation
+of ``state["params"]``, a sampler called as ``normal(key=k)``, a
+callable fetched from a dict — was widened to silence. This module is
+that missing hop: a statement-ordered abstract interpretation of one
+function body producing three fact families the summarizer
+(:mod:`callgraph`) folds into :class:`~callgraph.ModuleSummary`:
+
+* **derivation** (gap 1): for every expression, the set of parameters
+  it *provably* derives from, under must-semantics — an operand is
+  derived only when every path to the current statement built it from
+  parameters through value-preserving operations (arithmetic,
+  ``jax.numpy``/``jax.lax``/``jax.random`` calls, array methods,
+  container fields). A call to an unknown function, a read of a static
+  attribute (``.shape``, ``.dtype``), or a branch that rebinds on one
+  arm all widen to "not derived". Host-sync sites are re-detected over
+  derived operands, so ``float(jnp.mean(x))`` on a traced parameter is
+  a proof, not a guess.
+* **field paths** (gap 2/3): ``state["params"]``, ``cfg.step`` and
+  friends canonicalize to textual paths (:func:`field_path`) with a
+  component-wise conflict test (:func:`paths_conflict`), so donation
+  arming, rebind kills and key tracking distinguish sibling fields
+  while a read of the whole container still conflicts with a dead
+  leaf.
+* **points-to** (gap 4): a bounded set of callable references per
+  path — ``h = HANDLERS["relu"]``, ``self.step = train_step``,
+  ``Cfg(step=f)`` — kept only while every store to the path is a
+  recognized reference (one lambda, one unknown call result, one
+  non-constant subscript store and the whole subtree widens to
+  ``None`` = silence). The summarizer attaches the candidates to call
+  sites; the graph pass treats a fact as proven only when *all*
+  candidates carry it.
+
+Everything here is honest-widening by construction: the analysis only
+ever *adds* proofs on top of the r17 behavior, never speculates. The
+semantic fact tables (``SYNC_NP`` etc.) live here so this module stays
+import-cycle-free (``callgraph`` imports us and re-exports them for
+``rules``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .core import Module
+
+__all__ = [
+    "ARRAY_METHODS", "BLOCKING_BUILTINS", "DERIVING_PREFIXES",
+    "FunctionFlow", "KEY_DERIVERS", "KEY_PARAM_PAT", "NP_BLOCKERS",
+    "PT_BOUND", "STATIC_ATTRS", "STEP_ATTRS", "SYNC_NP",
+    "analyze_function", "field_path", "is_key_param", "is_key_path",
+    "last_component", "module_maps", "path_prefix_of", "path_root",
+    "path_suffix", "paths_conflict",
+]
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# ---- semantic fact tables (shared with rules.py via callgraph re-export)
+
+SYNC_NP = {"asarray", "array", "sum", "mean", "std", "var", "max", "min",
+           "argmax", "argmin", "any", "all", "allclose", "isnan",
+           "isfinite", "isinf", "where", "concatenate", "stack", "dot",
+           "matmul", "prod", "abs", "clip", "sqrt", "exp", "log",
+           "float32", "float64", "int32", "int64"}
+NP_BLOCKERS = {"numpy.asarray", "numpy.array"}
+BLOCKING_BUILTINS = {"float", "int", "bool"}
+STEP_ATTRS = {"run_step", "forward_only", "train_step", "eval_step"}
+KEY_DERIVERS = {"PRNGKey", "key", "fold_in", "key_data", "wrap_key_data",
+                "clone", "key_impl"}
+KEY_PARAM_PAT = ("rng", "key", "prng", "seed_key")
+
+# attributes whose value is host metadata, not the traced array — a
+# derivation chain through one of these is NOT a device value
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "nbytes",
+                "sharding", "device", "devices", "aval", "weak_type",
+                "name", "__name__"}
+# resolved-prefix call families that return values derived from their
+# array arguments (jnp.mean(x) is as traced as x)
+DERIVING_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.scipy.",
+                     "jax.random.", "jax.tree_util.", "jax.tree.")
+DERIVING_EXACT = {"jax.device_put", "jax.block_until_ready"}
+# members of the deriving families that return host metadata instead
+_NONDERIVING_MEMBERS = {"shape", "ndim", "size", "dtype", "result_type",
+                        "iinfo", "finfo", "save", "load"}
+# array methods whose result derives from the receiver; .item()/.tolist()
+# are deliberately absent (they return host scalars — the sync detector
+# owns them, the derivation must stop)
+ARRAY_METHODS = {"sum", "mean", "max", "min", "argmax", "argmin", "std",
+                 "var", "prod", "reshape", "astype", "transpose", "dot",
+                 "ravel", "squeeze", "flatten", "copy", "conj", "cumsum",
+                 "cumprod", "clip", "round", "repeat", "take",
+                 "swapaxes", "at", "set", "add", "get", "block_until_ready"}
+
+PT_BOUND = 4  # max points-to candidates per path before widening
+
+
+def is_key_param(name: str) -> bool:
+    low = name.lower()
+    return any(low == p or low.endswith("_" + p) or low.startswith(p + "_")
+               or low.rstrip("0123456789") == p for p in KEY_PARAM_PAT)
+
+
+# ============================================================ field paths
+
+def field_path(node: ast.AST) -> Optional[str]:
+    """Canonical textual path of a Name/Attribute/Subscript chain:
+    ``x`` / ``x.attr`` / ``x['key']`` / ``x[0]`` — composable. None for
+    anything else (a non-constant subscript key, a call in the chain):
+    such a value has no stable identity, so every consumer widens."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return "".join(reversed(parts))
+        if isinstance(node, ast.Attribute):
+            parts.append("." + node.attr)
+            node = node.value
+            continue
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) \
+                    and isinstance(sl.value, (str, int)):
+                parts.append(f"[{sl.value!r}]")
+                node = node.value
+                continue
+            return None
+        return None
+
+
+def path_root(path: str) -> str:
+    for i, ch in enumerate(path):
+        if ch in ".[":
+            return path[:i]
+    return path
+
+
+def path_suffix(path: str) -> str:
+    return path[len(path_root(path)):]
+
+
+def last_component(path: str) -> str:
+    """The final segment of a path, unquoted: ``state['rng']`` -> rng,
+    ``cfg.key`` -> key, ``k`` -> k."""
+    depth = 0
+    for i in range(len(path) - 1, -1, -1):
+        ch = path[i]
+        if ch == "]":
+            depth += 1
+        elif ch == "[" and depth:
+            depth -= 1
+            if not depth:
+                return path[i + 1:-1].strip("'\"")
+        elif ch == "." and not depth:
+            return path[i + 1:]
+    return path
+
+
+def is_key_path(path: str) -> bool:
+    """A path whose final component is key-named — the paths the GL011
+    replay tracks lazily when they root at a parameter."""
+    return is_key_param(last_component(path))
+
+
+def path_prefix_of(shorter: str, longer: str) -> bool:
+    """True when ``shorter`` is ``longer`` or a component-wise prefix of
+    it (``state`` covers ``state['params'].w`` but not ``state2``)."""
+    return longer.startswith(shorter) and (
+        len(longer) == len(shorter) or longer[len(shorter)] in ".[")
+
+
+def paths_conflict(a: str, b: str) -> bool:
+    """Either path covers the other: a read of ``state`` conflicts with
+    a donated ``state['params']`` and vice versa; ``state['opt']`` does
+    not."""
+    return path_prefix_of(a, b) or path_prefix_of(b, a)
+
+
+# ========================================================== shared helpers
+
+def _shallow_exprs(node: ast.AST) -> Iterator[ast.AST]:
+    """This statement's own expression nodes, source-ordered enough for
+    sync detection: no nested statements, no nested function/lambda
+    bodies (their dataflow is their own scope's problem)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, ast.stmt) or isinstance(c, _FUNC_DEFS) \
+                    or isinstance(c, ast.Lambda):
+                continue
+            stack.append(c)
+
+
+def _is_ref(node: ast.AST) -> Optional[str]:
+    """The canonical text of a plain callable *reference* (Name or
+    dotted Attribute chain) — the only values the points-to map stores;
+    anything computed widens."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        p = node
+        while isinstance(p, ast.Attribute):
+            p = p.value
+        if isinstance(p, ast.Name):
+            try:
+                return ast.unparse(node)
+            except Exception:  # pragma: no cover - defensive
+                return None
+    return None
+
+
+# ============================================================= module maps
+
+def module_maps(module: Module) -> Tuple[Dict[str, Optional[Tuple[str, ...]]],
+                                         Dict[str, Dict[str, Optional[
+                                             Tuple[str, ...]]]],
+                                         Set[str]]:
+    """(module-level points-to env, per-class attribute points-to map,
+    class names). The class map unions every recognized reference store
+    to an attribute — class-body assigns plus ``self.attr = ref`` across
+    all methods; any non-reference store to the same attribute widens it
+    to ``None`` (a call through it proves nothing).
+
+    The module env only keeps facts the WHOLE module agrees on: after
+    the module-body scan, every function-body statement that mutates a
+    module-level path (``HANDLERS[name] = fn`` registration, ``del``,
+    ``CFG.step = other``) or lets the container object escape as a bare
+    reference (aliased, passed as an argument, returned) widens the
+    touched subtree — a dispatch through it then proves nothing, per
+    the r17 contract."""
+    penv: Dict[str, Optional[Tuple[str, ...]]] = {}
+    class_pt: Dict[str, Dict[str, Optional[Tuple[str, ...]]]] = {}
+    classes: Set[str] = set()
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        classes.add(node.name)
+        attrs = class_pt.setdefault(node.name, {})
+
+        def store(attr: str, value: ast.AST) -> None:
+            ref = _is_ref(value)
+            if ref is None or isinstance(value, ast.Lambda):
+                attrs[attr] = None  # widened: unprovable store
+                return
+            if attr in attrs and attrs[attr] is None:
+                return
+            cur = tuple(attrs.get(attr) or ())
+            if ref not in cur:
+                cur = cur + (ref,)
+            attrs[attr] = cur if len(cur) <= PT_BOUND else None
+
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        store(t.id, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                store(stmt.target.id, stmt.value)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    store(t.attr, sub.value)
+
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            _pt_assign(penv, node.targets[0].id, node.value,
+                       classes=classes, class_pt=class_pt)
+
+    _widen_module_mutations(module, penv, class_pt)
+    return penv, class_pt, classes
+
+
+def _widen_module_mutations(
+        module: Module, penv: Dict[str, Optional[Tuple[str, ...]]],
+        class_pt: Dict[str, Dict[str, Optional[Tuple[str, ...]]]]) -> None:
+    """Honest-widening escape pass over every scope BELOW the module
+    body: stores/deletes through a module-level path kill that subtree;
+    a bare Load of a tracked container root (not as the base of a
+    canonical field read) means the object escaped — anyone may mutate
+    it, so the whole root widens."""
+    roots = {path_root(p) for p in penv}
+
+    def widen(path: str) -> None:
+        for k in [p for p in penv if path_prefix_of(path, p)]:
+            penv[k] = None
+        penv[path] = None
+
+    module_stmts = {id(s) for s in module.tree.body}
+    for node in ast.walk(module.tree):
+        if id(node) in module_stmts:
+            continue
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (list(node.targets) if isinstance(node, ast.Assign)
+                       else [node.target])
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        else:
+            continue
+        for t in targets:
+            for e in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                      else [t]):
+                p = field_path(e)
+                if p is None and isinstance(e, ast.Subscript):
+                    p = field_path(e.value)  # dynamic key: widen base
+                if p is not None and path_root(p) in roots:
+                    widen(p)
+                if isinstance(e, ast.Attribute) \
+                        and isinstance(e.value, ast.Name) \
+                        and e.value.id in class_pt:
+                    # Cfg.step = ... from below module scope: the class
+                    # default is no longer a proof for ANY instance
+                    class_pt[e.value.id][e.attr] = None
+    # escape scan: a tracked container used as a bare reference (not the
+    # base of a canonical path read) may be mutated by whoever got it;
+    # a mutating method through any chain is mutation outright
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Name) and node.id in roots
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        top = node
+        parent = module.parent.get(top)
+        while isinstance(parent, (ast.Attribute, ast.Subscript)) \
+                and parent.value is top:
+            top = parent
+            parent = module.parent.get(top)
+        if top is node:
+            # bare use: len(HANDLERS), f(HANDLERS), h = HANDLERS — the
+            # object is out of the module env's hands now
+            widen(node.id)
+        elif isinstance(top, ast.Attribute) and top.attr in _MUTATORS \
+                and isinstance(parent, ast.Call) and parent.func is top:
+            widen(node.id)
+
+
+_MUTATORS = frozenset({
+    "update", "clear", "pop", "popitem", "setdefault",
+    "append", "extend", "insert", "remove"})
+
+
+def _pt_assign(penv: Dict[str, Optional[Tuple[str, ...]]], base: str,
+               value: ast.AST,
+               classes: Optional[Set[str]] = None,
+               class_pt: Optional[Dict[str, Dict[str, Optional[
+                   Tuple[str, ...]]]]] = None) -> None:
+    """Record a ``base = value`` store into a points-to env: reference
+    texts, dict literals (per-constant-key entries plus an all-keys
+    wildcard when every value is a reference), a local-class constructor
+    call (``CFG = Cfg(step=fn)`` — per-kwarg attribute entries over the
+    class defaults), everything else widens the subtree."""
+    for k in [p for p in penv if path_prefix_of(base, p)]:
+        del penv[k]
+    if classes and isinstance(value, ast.Call) \
+            and isinstance(value.func, ast.Name) \
+            and value.func.id in classes \
+            and not value.args \
+            and all(kw.arg is not None for kw in value.keywords):
+        defaults = (class_pt or {}).get(value.func.id, {})
+        for attr, cands in defaults.items():
+            penv[f"{base}.{attr}"] = cands
+        for kw in value.keywords:
+            ref = _is_ref(kw.value)
+            # a non-ref kwarg blocks the class default for that field
+            penv[f"{base}.{kw.arg}"] = \
+                (ref,) if ref is not None else None
+        return
+    if isinstance(value, ast.Dict):
+        complete = True
+        wild: List[str] = []
+        for kx, vx in zip(value.keys, value.values):
+            ref = _is_ref(vx)
+            if ref is None:
+                complete = False
+                continue
+            wild.append(ref)
+            if isinstance(kx, ast.Constant) \
+                    and isinstance(kx.value, (str, int)):
+                penv[f"{base}[{kx.value!r}]"] = (ref,)
+        if complete and wild and len(set(wild)) <= PT_BOUND:
+            penv[base + "[*]"] = tuple(dict.fromkeys(wild))
+        else:
+            penv[base + "[*]"] = None
+        return
+    ref = _is_ref(value)
+    if ref is not None and not isinstance(value, ast.Lambda):
+        penv[base] = (ref,)
+    else:
+        penv[base] = None  # widened
+
+
+# ======================================================== function analysis
+
+class FunctionFlow:
+    """The per-function result the summarizer consumes: proven host-sync
+    sites (with the parameter set each operand derives from) and
+    points-to candidate lists keyed by ``id(Call node)``."""
+
+    def __init__(self) -> None:
+        self.syncs: List[dict] = []
+        self.candidates: Dict[int, List[str]] = {}
+
+
+_EMPTY: FrozenSet[str] = frozenset()
+
+
+class _Walker:
+    """One pass over a function body maintaining two environments:
+
+    * ``denv``: path -> frozenset of parameter roots it provably derives
+      from (empty set = an explicit kill that blocks prefix fallback);
+    * ``penv``: path -> tuple of callable reference texts, or ``None``
+      for a widened subtree.
+
+    Branch arms run on copies and merge under must-semantics: a
+    derivation survives only when every surviving arm agrees; a
+    points-to entry missing from any arm widens."""
+
+    def __init__(self, module: Module, params: Set[str],
+                 class_pt: Dict[str, Dict[str, Optional[Tuple[str, ...]]]],
+                 classes: Set[str], cls: Optional[str]) -> None:
+        self.module = module
+        self.params = params
+        self.class_pt = class_pt
+        self.classes = classes
+        self.cls = cls
+        self.flow = FunctionFlow()
+        self._seen_syncs: Set[int] = set()
+
+    # ---------------------------------------------------------- derivation
+
+    def deriv(self, node: ast.AST,
+              denv: Dict[str, FrozenSet[str]]) -> FrozenSet[str]:
+        if isinstance(node, ast.Name):
+            return denv.get(node.id, _EMPTY)
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            # any static-metadata hop (x.shape[0], x.dtype.name) makes
+            # the whole chain trace-static — not a derived value
+            link: ast.AST = node
+            while isinstance(link, (ast.Attribute, ast.Subscript)):
+                if isinstance(link, ast.Attribute) \
+                        and link.attr in STATIC_ATTRS:
+                    return _EMPTY
+                link = link.value
+            path = field_path(node)
+            if path is not None:
+                cur = path
+                while True:
+                    if cur in denv:
+                        return denv[cur]
+                    root = path_root(cur)
+                    if cur == root:
+                        return _EMPTY
+                    cur = self._parent_path(cur)
+            return self.deriv(node.value, denv)
+        if isinstance(node, ast.BinOp):
+            return self.deriv(node.left, denv) | self.deriv(node.right,
+                                                            denv)
+        if isinstance(node, ast.UnaryOp):
+            return self.deriv(node.operand, denv)
+        if isinstance(node, ast.Compare):
+            out = self.deriv(node.left, denv)
+            for c in node.comparators:
+                out |= self.deriv(c, denv)
+            return out
+        if isinstance(node, ast.BoolOp):
+            # `a and b` returns ONE operand: proven only if all are
+            parts = [self.deriv(v, denv) for v in node.values]
+            return frozenset().union(*parts) if all(parts) else _EMPTY
+        if isinstance(node, ast.IfExp):
+            a, b = self.deriv(node.body, denv), self.deriv(node.orelse,
+                                                           denv)
+            return a | b if (a and b) else _EMPTY
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out: FrozenSet[str] = _EMPTY
+            for e in node.elts:
+                out |= self.deriv(e, denv)
+            return out
+        if isinstance(node, ast.Starred):
+            return self.deriv(node.value, denv)
+        if isinstance(node, ast.Await):
+            return self.deriv(node.value, denv)
+        if isinstance(node, ast.NamedExpr):
+            return self.deriv(node.value, denv)
+        if isinstance(node, ast.Call):
+            return self._call_deriv(node, denv)
+        return _EMPTY
+
+    @staticmethod
+    def _parent_path(path: str) -> str:
+        depth = 0
+        for i in range(len(path) - 1, -1, -1):
+            ch = path[i]
+            if ch == "]":
+                depth += 1
+            elif ch == "[":
+                depth -= 1
+                if not depth:
+                    return path[:i]
+            elif ch == "." and not depth:
+                return path[:i]
+        return path_root(path)
+
+    def _call_deriv(self, call: ast.Call,
+                    denv: Dict[str, FrozenSet[str]]) -> FrozenSet[str]:
+        fn = self.module.resolve(call.func)
+        args_deriv: FrozenSet[str] = _EMPTY
+        for a in call.args:
+            args_deriv |= self.deriv(a, denv)
+        for k in call.keywords:
+            args_deriv |= self.deriv(k.value, denv)
+        if fn is not None:
+            member = fn.rsplit(".", 1)[-1]
+            if fn in DERIVING_EXACT:
+                return args_deriv
+            if fn.startswith(DERIVING_PREFIXES) \
+                    and member not in _NONDERIVING_MEMBERS:
+                return args_deriv
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in ARRAY_METHODS:
+            return self.deriv(func.value, denv) | args_deriv
+        return _EMPTY  # unknown callee: honest widening
+
+    # -------------------------------------------------------- sync shapes
+
+    def _sync_check(self, call: ast.Call,
+                    denv: Dict[str, FrozenSet[str]]) -> Optional[dict]:
+        """The GL002/GL007 host-sync shapes, with derived (not merely
+        parameter-rooted) operands. Returns the proven record or None."""
+        func = call.func
+        fn = self.module.resolve(func)
+        hit: Optional[Tuple[ast.AST, str, bool]] = None
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not call.args:
+            hit = (func.value, ".item()", True)
+        elif isinstance(func, ast.Name) and func.id in BLOCKING_BUILTINS \
+                and len(call.args) == 1 \
+                and not isinstance(call.args[0], ast.Constant):
+            hit = (call.args[0], f"{func.id}()", True)
+        elif fn and fn.startswith("numpy.") \
+                and fn.split(".")[-1] in SYNC_NP:
+            for a in call.args:
+                if self.deriv(a, denv):
+                    hit = (a, fn, fn in NP_BLOCKERS)
+                    break
+        elif fn == "jax.device_get" and call.args:
+            hit = (call.args[0], "jax.device_get", False)
+        elif isinstance(func, ast.Attribute) \
+                and func.attr == "block_until_ready":
+            hit = (func.value, "block_until_ready", False)
+        if hit is None:
+            return None
+        operand, desc, blocking = hit
+        roots = self.deriv(operand, denv)
+        if not roots:
+            return None
+        direct = isinstance(operand, ast.Name) and operand.id in roots
+        params = sorted(roots)
+        return {"param": params[0], "params": params, "desc": desc,
+                "blocking": blocking, "derived": not direct}
+
+    # ----------------------------------------------------------- points-to
+
+    def _pt_lookup(self, node: ast.AST,
+                   penv: Dict[str, Optional[Tuple[str, ...]]],
+                   cenv: Dict[str, str]) -> Optional[Tuple[str, ...]]:
+        path = field_path(node)
+        if path is not None:
+            if path in penv:
+                return penv[path]
+            # a widened ancestor poisons the whole subtree
+            cur = path
+            while True:
+                root = path_root(cur)
+                if cur == root:
+                    break
+                cur = self._parent_path(cur)
+                if penv.get(cur, ()) is None:
+                    return None
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name):
+                cname = cenv.get(node.value.id)
+                if cname and cname in self.class_pt:
+                    return self.class_pt[cname].get(node.attr)
+            return None
+        if isinstance(node, ast.Subscript):
+            base = field_path(node.value)
+            if base is not None:
+                return penv.get(base + "[*]")
+        return None
+
+    # --------------------------------------------------- statement walking
+
+    def visit_exprs(self, stmt: ast.stmt,
+                    denv: Dict[str, FrozenSet[str]],
+                    penv: Dict[str, Optional[Tuple[str, ...]]],
+                    cenv: Dict[str, str]) -> None:
+        stmt_calls = [n for n in _shallow_exprs(stmt)
+                      if isinstance(n, ast.Call)]
+        stmt_calls.sort(key=lambda c: (getattr(c, "lineno", 0),
+                                       getattr(c, "col_offset", 0)))
+        for call in stmt_calls:
+            if id(call) not in self._seen_syncs:
+                self._seen_syncs.add(id(call))
+                hit = self._sync_check(call, denv)
+                if hit is not None:
+                    line = getattr(call, "lineno", 1)
+                    hit.update({"line": line,
+                                "col": getattr(call, "col_offset", 0) + 1,
+                                "snippet": self.module.snippet(line)})
+                    self.flow.syncs.append(hit)
+            cands = self._pt_lookup(call.func, penv, cenv)
+            if cands:
+                self.flow.candidates.setdefault(id(call), list(cands))
+
+    def _kill(self, path: str, denv: Dict[str, FrozenSet[str]],
+              penv: Dict[str, Optional[Tuple[str, ...]]],
+              cenv: Dict[str, str]) -> None:
+        for k in [p for p in denv if path_prefix_of(path, p)]:
+            del denv[k]
+        for k in [p for p in penv if path_prefix_of(path, p)]:
+            del penv[k]
+        denv[path] = _EMPTY
+        cenv.pop(path, None)
+
+    def assign(self, target: ast.AST, value: Optional[ast.AST],
+               denv: Dict[str, FrozenSet[str]],
+               penv: Dict[str, Optional[Tuple[str, ...]]],
+               cenv: Dict[str, str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) \
+                    and len(value.elts) == len(target.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self.assign(t, v, denv, penv, cenv)
+                return
+            dv = self.deriv(value, denv) if value is not None else _EMPTY
+            for t in target.elts:
+                t2 = t.value if isinstance(t, ast.Starred) else t
+                self._assign_one(t2, value, dv, denv, penv, cenv,
+                                 exact=False)
+            return
+        dv = self.deriv(value, denv) if value is not None else _EMPTY
+        self._assign_one(target, value, dv, denv, penv, cenv, exact=True)
+
+    def _assign_one(self, target: ast.AST, value: Optional[ast.AST],
+                    dv: FrozenSet[str],
+                    denv: Dict[str, FrozenSet[str]],
+                    penv: Dict[str, Optional[Tuple[str, ...]]],
+                    cenv: Dict[str, str], exact: bool) -> None:
+        path = field_path(target)
+        if path is None:
+            # e.g. d[i] = v: an unidentifiable store widens the base
+            base = field_path(getattr(target, "value", None)) \
+                if isinstance(target, ast.Subscript) else None
+            if base is not None:
+                self._kill(base, denv, penv, cenv)
+                denv[base] = _EMPTY
+            return
+        self._kill(path, denv, penv, cenv)
+        denv[path] = dv  # empty = explicit not-derived kill
+        if value is None or not exact:
+            return
+        # points-to transfer
+        vpath = field_path(value) if isinstance(
+            value, (ast.Name, ast.Attribute, ast.Subscript)) else None
+        if vpath is not None and (
+                vpath in penv or vpath in cenv
+                or any(path_prefix_of(vpath, p) for p in penv)):
+            # alias copy: mirror the source's points-to subtree
+            if vpath in penv:
+                penv[path] = penv[vpath]
+            for k in [p for p in penv if path_prefix_of(vpath, p)
+                      and p != vpath]:
+                penv[path + k[len(vpath):]] = penv[k]
+            if vpath in cenv:
+                cenv[path] = cenv[vpath]
+            return
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+                and value.func.id in self.classes \
+                and not any(k.arg is None for k in value.keywords):
+            # Cfg(step=fn): dataclass-style constructor field stores
+            cenv[path] = value.func.id
+            for k in value.keywords:
+                if k.arg is None:
+                    continue
+                ref = _is_ref(k.value)
+                fpath = f"{path}.{k.arg}"
+                penv[fpath] = (ref,) if ref is not None \
+                    and not isinstance(k.value, ast.Lambda) else None
+            return
+        _pt_assign(penv, path, value)
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            v2 = field_path(value)
+            if v2 is not None and v2 in cenv:
+                cenv[path] = cenv[v2]
+
+    # the env-triple type is heavy; pass the three dicts positionally
+    def walk(self, stmts: List[ast.stmt],
+             denv: Dict[str, FrozenSet[str]],
+             penv: Dict[str, Optional[Tuple[str, ...]]],
+             cenv: Dict[str, str]) -> bool:
+        """Walk ``stmts`` updating the envs in place. Returns True when
+        the suite provably terminates (return/raise/break/continue)."""
+        for s in stmts:
+            if isinstance(s, _FUNC_DEFS) or isinstance(s, ast.ClassDef):
+                self._kill(s.name, denv, penv, cenv)
+                continue
+            self.visit_exprs(s, denv, penv, cenv)
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    self.assign(t, s.value, denv, penv, cenv)
+            elif isinstance(s, ast.AnnAssign):
+                if s.value is not None:
+                    self.assign(s.target, s.value, denv, penv, cenv)
+            elif isinstance(s, ast.AugAssign):
+                path = field_path(s.target)
+                dv = self.deriv(s.target, denv) | self.deriv(s.value,
+                                                             denv)
+                if path is not None:
+                    self._kill(path, denv, penv, cenv)
+                    denv[path] = dv
+            elif isinstance(s, ast.If):
+                self._walk_arms(
+                    [s.body, s.orelse], denv, penv, cenv)
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                self._walk_loop(s, denv, penv, cenv)
+            elif isinstance(s, ast.While):
+                self._walk_loop(s, denv, penv, cenv)
+            elif isinstance(s, ast.Try) \
+                    or s.__class__.__name__ == "TryStar":
+                self._walk_try(s, denv, penv, cenv)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    if item.optional_vars is not None:
+                        self.assign(item.optional_vars, None,
+                                    denv, penv, cenv)
+                if self.walk(s.body, denv, penv, cenv):
+                    return True
+            elif isinstance(s, ast.Delete):
+                for t in s.targets:
+                    p = field_path(t)
+                    if p is not None:
+                        self._kill(p, denv, penv, cenv)
+            elif isinstance(s, (ast.Global, ast.Nonlocal)):
+                for n in s.names:
+                    self._kill(n, denv, penv, cenv)
+            elif isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                                ast.Continue)):
+                return True
+        return False
+
+    def _copies(self, denv: Dict[str, FrozenSet[str]],
+                penv: Dict[str, Optional[Tuple[str, ...]]],
+                cenv: Dict[str, str]) -> Tuple[dict, dict, dict]:
+        return dict(denv), dict(penv), dict(cenv)
+
+    @staticmethod
+    def _merge_into(denv: Dict[str, FrozenSet[str]],
+                    penv: Dict[str, Optional[Tuple[str, ...]]],
+                    cenv: Dict[str, str],
+                    arms: List[Tuple[dict, dict, dict]]) -> None:
+        """Must-merge the arm envs into the outer envs in place."""
+        denv.clear()
+        penv.clear()
+        cenv.clear()
+        if not arms:
+            return
+        dkeys = set().union(*(a[0] for a in arms))
+        for k in dkeys:
+            vals = [a[0].get(k, _EMPTY) for a in arms]
+            denv[k] = (frozenset().union(*vals)
+                       if all(vals) else _EMPTY)
+        pkeys = set().union(*(a[1] for a in arms))
+        for k in pkeys:
+            vals = [a[1].get(k, ()) for a in arms]  # () = unbound arm
+            if any(v is None or v == () for v in vals):
+                penv[k] = None  # an arm without the binding widens it
+                continue
+            merged = tuple(dict.fromkeys(r for v in vals for r in v))
+            penv[k] = merged if len(merged) <= PT_BOUND else None
+        ckeys = set().union(*(a[2] for a in arms))
+        for k in ckeys:
+            vals = {a[2].get(k) for a in arms}
+            if len(vals) == 1 and None not in vals:
+                cenv[k] = vals.pop()
+
+    def _walk_arms(self, suites: List[List[ast.stmt]],
+                   denv: Dict[str, FrozenSet[str]],
+                   penv: Dict[str, Optional[Tuple[str, ...]]],
+                   cenv: Dict[str, str]) -> None:
+        survivors: List[Tuple[dict, dict, dict]] = []
+        for suite in suites:
+            arm = self._copies(denv, penv, cenv)
+            if not self.walk(suite, *arm):
+                survivors.append(arm)
+        self._merge_into(denv, penv, cenv, survivors)
+
+    def _walk_loop(self, s: ast.AST,
+                   denv: Dict[str, FrozenSet[str]],
+                   penv: Dict[str, Optional[Tuple[str, ...]]],
+                   cenv: Dict[str, str]) -> None:
+        entry = self._copies(denv, penv, cenv)
+        body = self._copies(denv, penv, cenv)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            dv = self.deriv(s.iter, denv)
+            tpath = field_path(s.target)
+            if tpath is not None:
+                self._kill(tpath, *body)
+                body[0][tpath] = dv
+            else:
+                self.assign(s.target, None, *body)
+        self.walk(s.body, *body)
+        # after the loop: zero-or-more iterations ran
+        self._merge_into(denv, penv, cenv, [entry, body])
+        self.walk(s.orelse, denv, penv, cenv)
+
+    def _walk_try(self, s: ast.AST,
+                  denv: Dict[str, FrozenSet[str]],
+                  penv: Dict[str, Optional[Tuple[str, ...]]],
+                  cenv: Dict[str, str]) -> None:
+        entry = self._copies(denv, penv, cenv)
+        body = self._copies(denv, penv, cenv)
+        body_done = self.walk(s.body, *body)
+        if not body_done:
+            body_done = self.walk(s.orelse, *body)
+        # a handler runs after an arbitrary body prefix: its entry state
+        # keeps only facts surviving both the entry and the full body
+        hentry = self._copies(*entry)
+        self._merge_into(*hentry, [entry, body])
+        survivors: List[Tuple[dict, dict, dict]] = []
+        if not body_done:
+            survivors.append(body)
+        for h in s.handlers:
+            arm = self._copies(*hentry)
+            if h.name:
+                self._kill(h.name, *arm)
+            if not self.walk(h.body, *arm):
+                survivors.append(arm)
+        self._merge_into(denv, penv, cenv, survivors or [entry])
+        self.walk(s.finalbody, denv, penv, cenv)
+
+
+def analyze_function(module: Module, node: ast.AST, cls: Optional[str],
+                     class_pt: Dict[str, Dict[str, Optional[
+                         Tuple[str, ...]]]],
+                     module_env: Dict[str, Optional[Tuple[str, ...]]],
+                     classes: Set[str]) -> FunctionFlow:
+    """Run the value-flow walk over one function def; the result feeds
+    :func:`callgraph._summarize_function` (sync records with derivation
+    sets; per-call-site points-to candidates)."""
+    a = node.args
+    params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    w = _Walker(module, params, class_pt, classes, cls)
+    denv: Dict[str, FrozenSet[str]] = {p: frozenset([p]) for p in params}
+    penv: Dict[str, Optional[Tuple[str, ...]]] = dict(module_env)
+    cenv: Dict[str, str] = {}
+    pos = a.posonlyargs + a.args
+    if cls and pos and pos[0].arg in ("self", "cls"):
+        cenv[pos[0].arg] = cls
+    try:
+        w.walk(list(node.body), denv, penv, cenv)
+    except RecursionError:  # pragma: no cover - pathological nesting
+        pass
+    return w.flow

@@ -66,6 +66,7 @@ from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 from . import callgraph
 from .core import Finding, Module, Rule, register
+from .dataflow import field_path, path_prefix_of, paths_conflict
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 _LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
@@ -425,14 +426,41 @@ class DonationAfterUse(Rule):
         for s in stmts:
             live = {t for t in pending}
             if live:
+                # maximal canonical read paths only: once state['params']
+                # is recorded, its inner Name `state` is not a separate
+                # read (otherwise a sibling-field read would conflict
+                # through its container root)
+                skip: Set[int] = set()
                 for n in _shallow_nodes(s):
-                    if not isinstance(n, (ast.Name, ast.Attribute)):
+                    if id(n) in skip:
+                        continue
+                    if not isinstance(
+                            n, (ast.Name, ast.Attribute, ast.Subscript)):
                         continue
                     if not isinstance(getattr(n, "ctx", None), ast.Load):
                         continue
-                    text = ast.unparse(n)
+                    text = field_path(n)
+                    if text is not None:
+                        for c in ast.walk(n):
+                            skip.add(id(c))
+                    elif isinstance(n, ast.Subscript):
+                        # dynamic index: the base container is read;
+                        # which field stays unproven, so only the base
+                        # chain participates (its slice is still walked)
+                        text = field_path(n.value)
+                        if text is None:
+                            continue
+                        for c in ast.walk(n.value):
+                            skip.add(id(c))
+                    else:
+                        continue
                     for donated in sorted(live):
-                        if text == donated or text.startswith(donated + "."):
+                        # component-wise both ways: reading the dead
+                        # field, a sub-path of it, or the whole
+                        # container that still holds it; a SIBLING
+                        # field (state['opt'] vs state['params'])
+                        # conflicts with neither
+                        if paths_conflict(text, donated):
                             yield module.finding(
                                 self, n,
                                 f"'{donated}' was donated to a jitted call "
@@ -450,8 +478,9 @@ class DonationAfterUse(Rule):
             for t in targets:
                 elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
                 for e in elts:
-                    if isinstance(e, (ast.Name, ast.Attribute)):
-                        target_texts.add(ast.unparse(e))
+                    tp = field_path(e)
+                    if tp is not None:
+                        target_texts.add(tp)
             for call in (n for n in _shallow_nodes(s)
                          if isinstance(n, ast.Call)):
                 try:
@@ -462,13 +491,16 @@ class DonationAfterUse(Rule):
                 if not positions:
                     continue
                 for p in positions:
-                    if p < len(call.args) and isinstance(
-                            call.args[p], (ast.Name, ast.Attribute)):
-                        donated = ast.unparse(call.args[p])
-                        if donated not in target_texts:
+                    if p < len(call.args):
+                        donated = field_path(call.args[p])
+                        if donated is not None \
+                                and donated not in target_texts:
                             pending[donated] = call
             for t in target_texts:
-                pending.pop(t, None)
+                # assigning the container kills its donated fields too
+                for d in list(pending):
+                    if path_prefix_of(t, d):
+                        pending.pop(d)
 
 
 # --------------------------------------------------------------------- GL004
@@ -1191,3 +1223,84 @@ class StrayPallasCall(Rule):
                         self, node,
                         f"pallas_call imported from {mod} outside ops/ "
                         f"— {suggestion}")
+
+
+# --------------------------------------------------------------------- GL013
+
+
+# duplicated from utils/perf.py (SANITIZE_REPORT_NAME) on purpose: the
+# analyzer must stay importable without jax
+SANITIZE_REPORT_NAME = "sanitize_report.json"
+
+
+@register
+class RuntimeCoverageGap(Rule):
+    """GL013: the runtime sanitizer (``--sanitize``) observed a violation
+    — a transfer-guard trip or a steady-state recompile — at a site the
+    static pass CLEARED. The two passes audit each other: a runtime
+    violation with no static finding at the same file+line means either
+    a rule blind spot (file an issue, the evidence names the exact site)
+    or true dynamic behavior no static pass can prove (audit it into the
+    baseline with --write-baseline). Only fires in
+    ``--runtime-evidence RUN_DIR`` mode; the per-module and graph passes
+    yield nothing."""
+
+    code = "GL013-runtime-coverage-gap"
+    description = ("runtime sanitizer evidence (sanitize_report.json) "
+                   "shows a violation at a site the static pass cleared "
+                   "— a coverage gap: rule blind spot or true dynamic "
+                   "behavior (only with --runtime-evidence)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        return iter(())
+
+
+_KIND_LABEL = {
+    "transfer_guard": "an implicit host<->device transfer tripped the "
+                      "transfer guard",
+    "steady_recompile": "XLA kept compiling after steady state",
+}
+
+
+def runtime_evidence_findings(violations: List[Dict[str, Any]],
+                              findings: List[Finding],
+                              rule: Optional[Rule] = None
+                              ) -> List[Finding]:
+    """Cross-reference runtime sanitizer violations against this run's
+    static findings. A violation is COVERED when some static finding
+    sits at the same file (two-component path tail — the fingerprint
+    normalization) and line: the linter already told the user. Anything
+    else surfaces as GL013 — the static pass vouched for a site the
+    runtime proved dirty."""
+    from .baseline import path_tail
+
+    rule = rule or RuntimeCoverageGap()
+    covered = {(path_tail(f.path), f.line) for f in findings}
+    out: List[Finding] = []
+    seen: set = set()
+    for v in violations:
+        vpath = str(v.get("path") or "")
+        vline = int(v.get("line") or 0)
+        if not vpath:
+            continue  # site-less evidence: nothing to cross-reference
+        if (path_tail(vpath), vline) in covered:
+            continue
+        kind = str(v.get("kind", "violation"))
+        key = (path_tail(vpath), vline, kind)
+        if key in seen:
+            continue  # one finding per site+kind, however many trips
+        seen.add(key)
+        label = _KIND_LABEL.get(kind, kind)
+        detail = str(v.get("detail", ""))[:200]
+        func = str(v.get("func", "") or "")
+        out.append(Finding(
+            rule=rule.code, path=vpath, line=max(1, vline), col=1,
+            message=(f"runtime evidence: {label}"
+                     + (f" in {func}()" if func else "")
+                     + (f" [{detail}]" if detail else "")
+                     + " — but the static pass reports no finding at "
+                       "this line; rule blind spot or true dynamic "
+                       "behavior (if dynamic, audit via "
+                       "--write-baseline)"),
+            snippet=str(v.get("snippet", ""))[:200]))
+    return out

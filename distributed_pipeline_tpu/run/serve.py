@@ -224,6 +224,12 @@ def _serve_single(settings: ServeSettings) -> dict:
         server.drain()
     finally:
         recompiles = server.stop_sanitizer()
+        # evidence sidecar beside the served checkpoint (ISSUE 19
+        # runtime bridge: analysis --runtime-evidence reads it)
+        _sr_dir = settings.checkpoint_path
+        if _sr_dir and not os.path.isdir(_sr_dir):
+            _sr_dir = os.path.dirname(_sr_dir) or "."
+        server.write_sanitize_report(_sr_dir)
     wall_s = time.perf_counter() - t0
 
     if settings.out:
@@ -503,6 +509,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
                 time.sleep(0.005)
     finally:
         server.stop_sanitizer()
+        server.write_sanitize_report(paths.root)
     # graceful stop: drain whatever is still in flight before exiting 0
     with proto.tracker.timed("drain_s"):
         while server.busy:
@@ -768,6 +775,7 @@ def _disagg_decode_main(settings: ServeSettings) -> dict:
                 time.sleep(0.005)
     finally:
         server.stop_sanitizer()
+        server.write_sanitize_report(paths.root)
     while server.busy:  # graceful stop: drain in-flight decodes
         server.step()
         tick += 1

@@ -32,6 +32,7 @@ Invariants the tests pin (tests/test_serving.py):
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import time
 from typing import Any, Deque, List, Optional
@@ -39,7 +40,8 @@ from typing import Any, Deque, List, Optional
 import jax
 import numpy as np
 
-from ..utils.perf import EventStats, RecompileMonitor, device_peak_flops
+from ..utils.perf import EventStats, RecompileMonitor, SanitizeReport, \
+    device_peak_flops
 from ..utils.perf import transformer_decode_flops_per_token \
     as decode_flops_per_token
 from .engine import DecodeEngine
@@ -111,7 +113,14 @@ class DecodeServer:
             # pool-smaller-than-worst-case regime is opt-in via max_pages
             max_pages = 1 + decode_slots * pages_per_slot
         self.sanitize = sanitize
-        self._recompiles = RecompileMonitor()
+        self._recompiles = RecompileMonitor(capture_sites=sanitize)
+        # Evidence sidecar (ISSUE 19 runtime bridge): guard trips and
+        # steady-state recompiles accumulate here; run/serve.py finalizes
+        # with write_sanitize_report() so the static pass can
+        # cross-reference (analysis --runtime-evidence, GL013).
+        self.sanitize_report = SanitizeReport()
+        self._recompiles_at_first_token: Optional[int] = None
+        self._sanitizer_reported = False
         if sanitize:
             self._recompiles.install()
         try:
@@ -173,9 +182,27 @@ class DecodeServer:
 
     def stop_sanitizer(self) -> int:
         """Detach the process-global sanitizer hooks; returns the final
-        compile count. Idempotent; no-op when sanitize was off."""
+        compile count. Idempotent; no-op when sanitize was off. Compiles
+        observed after the first fetched token (the serving steady-state
+        boundary — both phase executables exist by then) become
+        ``steady_recompile`` violations in the evidence report."""
         self._recompiles.uninstall()
+        if self.sanitize and not self._sanitizer_reported:
+            self._sanitizer_reported = True
+            if self._recompiles_at_first_token is not None:
+                self.sanitize_report.note_recompiles(
+                    self._recompiles, self._recompiles_at_first_token)
         return self._recompiles.count
+
+    def write_sanitize_report(self, out_dir: str) -> str:
+        """Finalize the evidence (stop_sanitizer, folding steady
+        recompiles in) and drop the sanitize_report.json sidecar in
+        ``out_dir``. Returns the written path, "" when sanitize was off
+        or the write failed (best-effort by design)."""
+        if not self.sanitize:
+            return ""
+        self.stop_sanitizer()
+        return self.sanitize_report.write(out_dir)
 
     @property
     def free_slots(self) -> int:
@@ -480,7 +507,15 @@ class DecodeServer:
     def step(self) -> bool:
         """One scheduler tick: sweep EOS completions -> admit -> dispatch
         decode -> lagged fetch. Returns False when nothing advanced (idle:
-        no queue, no active slots, no pending fetches)."""
+        no queue, no active slots, no pending fetches). Under sanitize the
+        tick runs inside the evidence watcher: the engine's own transfer
+        guard still raises on an implicit transfer, but the trip's site
+        lands in the report on the way out."""
+        with (self.sanitize_report.watch() if self.sanitize
+              else contextlib.nullcontext()):
+            return self._step_inner()
+
+    def _step_inner(self) -> bool:
         # EOS sweep: requests finished by content (observed at fetch, one
         # step late) release their slot before new work is admitted. Only
         # when a fetch actually flagged one — count-based completions
@@ -527,6 +562,11 @@ class DecodeServer:
         # with nothing left to dispatch there is no step to hide the
         # fetch behind, and drain() must be able to terminate.
         self._fetch(self.dispatch_lag if dispatched else 0)
+        if self.sanitize and self._recompiles_at_first_token is None \
+                and self.tokens_fetched > 0:
+            # serving steady-state boundary: everything compiled so far
+            # was warmup; growth beyond this snapshot is a violation
+            self._recompiles_at_first_token = self._recompiles.count
         return dispatched or bool(self._ring)
 
     def _fetch(self, lag: int) -> None:

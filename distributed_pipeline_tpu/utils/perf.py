@@ -9,10 +9,13 @@ first-class gauges, and nothing in the hot path blocks on the device.
 
 from __future__ import annotations
 
+import contextlib
+import json
 import logging
 import os
 import time
-from typing import Any, Callable, Optional, Tuple
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -20,6 +23,7 @@ __all__ = ["device_peak_flops", "transformer_train_flops_per_token",
            "transformer_decode_flops_per_token", "active_param_count",
            "StepTimer", "mfu", "enable_persistent_compilation_cache",
            "timed_lower_compile", "AOTStep", "RecompileMonitor",
+           "SanitizeReport", "SANITIZE_REPORT_NAME",
            "StallBreakdown", "EventStats", "GoodputTracker",
            "tree_bytes", "tree_bytes_per_replica", "peak_live_bytes"]
 
@@ -289,11 +293,14 @@ class RecompileMonitor(logging.Handler):
     line for diagnostics."""
 
     _MARKER = "Compiling "
+    _MAX_SITES = 16
 
-    def __init__(self) -> None:
+    def __init__(self, capture_sites: bool = False) -> None:
         super().__init__(level=logging.NOTSET)
         self.count = 0
         self.last: str = ""
+        self.sites: List[Dict[str, Any]] = []
+        self._capture_sites = capture_sites
         self._prev_flag: Optional[bool] = None
 
     def emit(self, record: logging.LogRecord) -> None:
@@ -304,6 +311,15 @@ class RecompileMonitor(logging.Handler):
         if msg.startswith(self._MARKER):
             self.count += 1
             self.last = msg.split("\n", 1)[0][:200]
+            if self._capture_sites and len(self.sites) < self._MAX_SITES:
+                # the compile log fires synchronously under the user's
+                # dispatch — the deepest non-library frame on the stack
+                # right now IS the host-side call that triggered it
+                site = _user_site(traceback.extract_stack())
+                if site is not None:
+                    site["detail"] = self.last
+                    site["ordinal"] = self.count
+                    self.sites.append(site)
 
     def install(self) -> "RecompileMonitor":
         self._prev_flag = bool(jax.config.jax_log_compiles)
@@ -321,6 +337,154 @@ class RecompileMonitor(logging.Handler):
 
     def __exit__(self, *exc: Any) -> None:
         self.uninstall()
+
+
+SANITIZE_REPORT_NAME = "sanitize_report.json"
+
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _user_site(frames: "traceback.StackSummary"
+               ) -> Optional[Dict[str, Any]]:
+    """Deepest frame that belongs to USER code — not jax/site-packages,
+    not the stdlib, not this module. That frame is where the evidence
+    points when the static pass is asked 'did you clear this site?'."""
+    for fr in reversed(list(frames)):
+        fn = fr.filename or ""
+        if (not fn or fn.startswith("<")
+                or "site-packages" in fn or "dist-packages" in fn
+                or "importlib" in fn
+                or os.path.abspath(fn) == _THIS_FILE
+                or fn.startswith(_STDLIB_DIR)):
+            continue
+        return {"path": os.path.abspath(fn), "line": int(fr.lineno or 1),
+                "func": fr.name or "?", "snippet": (fr.line or "")[:200]}
+    return None
+
+
+_STDLIB_DIR = os.path.dirname(os.path.abspath(contextlib.__file__))
+
+
+class SanitizeReport:
+    """Machine-readable evidence from the runtime sanitizer — the bridge
+    between ``--sanitize`` and the static pass (analysis/, GL013).
+
+    Violations accumulate as dicts ``{kind, path, line, func, detail,
+    snippet}`` where ``kind`` is ``transfer_guard`` (an implicit
+    host<->device transfer tripped ``jax.transfer_guard("disallow")``)
+    or ``steady_recompile`` (XLA compiles kept happening after steady
+    state). ``write(dir)`` drops a ``sanitize_report.json`` sidecar
+    atomically and never raises — evidence collection must not take the
+    run down with it. When ``default_dir`` is set, every ``record``
+    re-writes the sidecar so the evidence survives the crash that the
+    violation itself usually causes.
+
+    ``analysis --runtime-evidence RUN_DIR`` consumes the sidecar: a
+    violation at a site the static pass cleared is a GL013 coverage-gap
+    finding — the linter and the sanitizer audit each other instead of
+    silently disagreeing."""
+
+    VERSION = 1
+
+    def __init__(self, default_dir: str = "") -> None:
+        self.violations: List[Dict[str, Any]] = []
+        self.default_dir = default_dir
+
+    # ------------------------------------------------------------- capture
+
+    def record(self, kind: str, detail: str,
+               site: Optional[Dict[str, Any]] = None) -> None:
+        if site is None:  # {} means "explicitly no location"
+            site = _user_site(traceback.extract_stack()) or {}
+        self.violations.append({
+            "kind": kind,
+            "path": site.get("path", ""),
+            "line": site.get("line", 0),
+            "func": site.get("func", ""),
+            "snippet": site.get("snippet", ""),
+            "detail": detail[:500],
+        })
+        if self.default_dir:
+            self.write(self.default_dir)
+
+    @staticmethod
+    def _is_trip(exc: BaseException) -> bool:
+        return "isallow" in str(exc)  # [Dd]isallowed transfer guard trip
+
+    @staticmethod
+    def _site_from(exc: BaseException) -> Optional[Dict[str, Any]]:
+        return _user_site(traceback.extract_tb(exc.__traceback__))
+
+    @contextlib.contextmanager
+    def guard(self):
+        """``jax.transfer_guard("disallow")`` that records the trip —
+        site taken from the deepest user frame of the raising traceback
+        — before re-raising. The violation is never swallowed: sanitize
+        mode still fails loudly, it just leaves evidence behind."""
+        with jax.transfer_guard("disallow"):
+            try:
+                yield
+            except Exception as e:
+                if self._is_trip(e):
+                    self.record("transfer_guard", detail=str(e)[:500],
+                                site=self._site_from(e))
+                raise
+
+    @contextlib.contextmanager
+    def watch(self):
+        """Record-only variant for code that arms the transfer guard
+        itself (DecodeServer's engine): captures a trip's evidence as it
+        propagates, without arming a second guard."""
+        try:
+            yield
+        except Exception as e:
+            if self._is_trip(e):
+                self.record("transfer_guard", detail=str(e)[:500],
+                            site=self._site_from(e))
+            raise
+
+    def note_recompiles(self, monitor: RecompileMonitor,
+                        steady_after: int) -> None:
+        """Fold a monitor's captured compile sites into violations: every
+        compile OBSERVED after the first ``steady_after`` is a steady-
+        state recompile (the warmup budget is the caller's to define —
+        compiles-at-first-step for the trainer, compiles-at-first-token
+        for the decode server)."""
+        for site in monitor.sites:
+            if site.get("ordinal", 0) <= steady_after:
+                continue
+            self.record(
+                "steady_recompile",
+                detail=f"XLA compile after steady state "
+                       f"({site.get('detail', '')})",
+                site=site)
+        if not monitor.sites and monitor.count > steady_after:
+            # monitor ran without site capture: still leave evidence,
+            # just without a source location to cross-reference
+            self.record(
+                "steady_recompile",
+                detail=f"{monitor.count - steady_after} XLA compile(s) "
+                       f"after steady state ({monitor.last})",
+                site={})
+
+    # ------------------------------------------------------------- sidecar
+
+    def write(self, out_dir: str) -> str:
+        """Atomic best-effort sidecar write; returns the path ("" on any
+        failure — remote paths, read-only dirs, mid-teardown)."""
+        if not out_dir or "://" in out_dir:
+            return ""
+        path = os.path.join(out_dir, SANITIZE_REPORT_NAME)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"version": self.VERSION,
+                           "violations": self.violations}, f, indent=1)
+            os.replace(tmp, path)
+            return path
+        except OSError:  # pragma: no cover - defensive
+            return ""
 
 
 class StallBreakdown:

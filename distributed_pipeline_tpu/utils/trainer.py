@@ -60,7 +60,8 @@ from ..parallel.sharding import (
 )
 from . import checkpoint as ckpt_lib
 from . import logger
-from .perf import AOTStep, GoodputTracker, RecompileMonitor, StallBreakdown, \
+from .perf import AOTStep, GoodputTracker, RecompileMonitor, \
+    SanitizeReport, StallBreakdown, \
     StepTimer, device_peak_flops, mfu, peak_live_bytes, tree_bytes, \
     tree_bytes_per_replica, transformer_train_flops_per_token
 
@@ -290,7 +291,14 @@ class TrainLoop:
         # quietly serializing the step. Explicit device_put/device_get —
         # everything the loop does on purpose — stays legal.
         self.sanitize = sanitize
-        self._recompiles = RecompileMonitor()
+        self._recompiles = RecompileMonitor(capture_sites=sanitize)
+        # Machine-readable evidence sidecar (ISSUE 19 runtime bridge):
+        # every guard trip / steady recompile lands in
+        # <checkpoint_dir>/sanitize_report.json for the static pass to
+        # cross-reference (analysis --runtime-evidence, GL013).
+        self.sanitize_report = SanitizeReport(
+            default_dir=self.checkpoint_dir if sanitize else "")
+        self._sanitizer_reported = False
         if sanitize:
             self._recompiles.install()
         try:
@@ -739,12 +747,21 @@ class TrainLoop:
         handler and the jax_log_compiles flag) and return the final
         recompile count. Idempotent; a no-op when sanitize was off. Call
         when the loop is done in a process that keeps running (bench legs,
-        tests) — nothing re-arms it."""
+        tests) — nothing re-arms it. Also the moment the evidence sidecar
+        is finalized: steady-state recompiles become violations, and the
+        report (possibly empty — that's the 'ran clean' evidence) lands
+        beside the checkpoints."""
         self._recompiles.uninstall()
+        if self.sanitize and not self._sanitizer_reported:
+            self._sanitizer_reported = True
+            if self._recompiles_at_first_step is not None:
+                self.sanitize_report.note_recompiles(
+                    self._recompiles, self._recompiles_at_first_step)
+            self.sanitize_report.write(self.checkpoint_dir)
         return self._recompiles.count
 
     def _sanitize_guard(self):
-        return (jax.transfer_guard("disallow") if self.sanitize
+        return (self.sanitize_report.guard() if self.sanitize
                 else contextlib.nullcontext())
 
     # ------------------------------------------------------------- data prep
@@ -1232,6 +1249,10 @@ class TrainLoop:
             # final ledger snapshot: the attribution the run ends on
             self._write_ledger_snapshot(self.ledger_rows())
         self.tracer.close()
+        if self.sanitize:
+            # clean exit finalizes the evidence sidecar (trips already
+            # auto-wrote on the way down in the exception path)
+            self.stop_sanitizer()
 
     __call__ = run_loop  # reference trainer.py:357
 

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# graftlint CI gate, two passes over one analysis engine:
+#
+#   1. PR annotation pass — `--format github --changed <git diff files>`
+#      emits ::error workflow commands ONLY for findings in files this
+#      change touches (the analysis itself is still whole-program:
+#      cross-module facts need every summary). Skipped when the working
+#      tree is clean.
+#   2. Whole-program pass — every gated path (the same list the pytest
+#      gate in tests/test_graftlint_gate.py uses, imported from it so
+#      the two gates can never drift), through the content-hash cache
+#      beside the baseline. Fails on any finding outside the committed
+#      graftlint_baseline.json.
+#
+# Exit: 0 clean, 1 findings, 2 usage/setup error.
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$ROOT"
+PY="${PYTHON:-python}"
+
+# the gated path list lives in the pytest gate — single source of truth
+mapfile -t GATED < <("$PY" - <<'EOF'
+import tests.test_graftlint_gate as gate
+print("\n".join(gate.GATED_PATHS))
+EOF
+)
+if [ "${#GATED[@]}" -eq 0 ]; then
+    echo "lint_gate: could not load GATED_PATHS" >&2
+    exit 2
+fi
+
+# pass 1: annotate the changed files (diff against HEAD; in CI, set
+# LINT_GATE_DIFF_BASE=origin/main for the PR's merge base)
+base="${LINT_GATE_DIFF_BASE:-HEAD}"
+mapfile -t CHANGED < <(git diff --name-only "$base" -- '*.py' || true)
+if [ "${#CHANGED[@]}" -gt 0 ]; then
+    "$PY" -m distributed_pipeline_tpu.analysis \
+        --format github --changed "${CHANGED[@]}" -- "${GATED[@]}"
+fi
+
+# pass 2: the whole program, warm through the cache
+"$PY" -m distributed_pipeline_tpu.analysis -- "${GATED[@]}"

@@ -1430,3 +1430,700 @@ def test_recompile_monitor_counts_fresh_compiles():
     after = mon.count
     jax.jit(lambda x: x * 3.0 - 7.0)(jnp.ones((2, 2)))
     assert mon.count == after        # uninstalled: counting stopped
+
+
+# ===================================================================
+# ISSUE 19: value-flow engine — the four ROADMAP-7 blind spots as
+# pos/neg proof pairs, points-to edge cases, GL011 branch arms,
+# cache schema migration, and the runtime-evidence bridge (GL013)
+# ===================================================================
+
+# ---------------------------------------- gap 1: locally-derived syncs
+
+
+def test_derived_sync_in_traced_helper(tmp_path):
+    """GL002 through the value-flow engine: the helper syncs a value it
+    DERIVES from its parameter (jnp.sum of it), not the parameter
+    itself — the r17 pass was parameter-rooted only."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def helper(x):
+                s = jnp.sum(x * 2.0)
+                return float(s)
+
+            @jax.jit
+            def step(batch):
+                return helper(batch)
+        """})
+    got = [f for f in fs if f.rule == "GL002-host-sync"]
+    assert len(got) == 1
+    assert "derived from parameter 'x'" in got[0].message
+
+
+def test_underived_sync_in_traced_helper_is_clean(tmp_path):
+    """float() on a host-local constant inside a traced helper derives
+    from NO parameter — the derivation chain, not the call position,
+    is what convicts."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def helper(x):
+                cfg = {"lr": 0.1}
+                return float(cfg["lr"]) * jnp.sum(x)
+
+            @jax.jit
+            def step(batch):
+                return helper(batch)
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_derived_sync_rebound_operand_is_clean(tmp_path):
+    """Rebinding the derived name to something underived kills the
+    derivation — must-analysis, not taint-forever."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def helper(x):
+                s = jnp.sum(x)
+                s = 3.0
+                return float(s) * jnp.mean(x)
+
+            @jax.jit
+            def step(batch):
+                return helper(batch)
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_static_attr_chain_is_not_derived(tmp_path):
+    """shape/dtype reads are trace-static — float(x.shape[0]) is legal
+    under jit and must stay silent."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def helper(x):
+                return float(x.shape[0]) * 2.0
+
+            @jax.jit
+            def step(batch):
+                return helper(batch) + batch
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+# --------------------------------- gap 2: container-field donation (GL003)
+
+
+def test_donation_of_container_field_then_read(tmp_path):
+    """Donating state['params'] arms the FIELD path; reading that exact
+    field afterwards is use-after-free."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            opt_step = jax.jit(lambda p, g: p, donate_argnums=(0,))
+
+            def run(state, grads):
+                new_params = opt_step(state["params"], grads)
+                stale = state["params"]
+                return new_params, stale
+        """})
+    got = [f for f in fs if f.rule == "GL003-donation-after-use"]
+    assert len(got) == 1
+    assert "state['params']" in got[0].message
+
+
+def test_donation_sibling_field_read_is_clean(tmp_path):
+    """state['step'] shares a container with donated state['params'] but
+    not a buffer — sibling reads must not conflict."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            opt_step = jax.jit(lambda p, g: p, donate_argnums=(0,))
+
+            def run(state, grads):
+                new_params = opt_step(state["params"], grads)
+                fine = state["step"]
+                return new_params, fine
+        """})
+    assert "GL003-donation-after-use" not in codes(fs)
+
+
+def test_donation_field_rebind_is_clean(tmp_path):
+    """Storing the call's result back into the donated field is the
+    sanctioned idiom, field-sensitively."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            opt_step = jax.jit(lambda p, g: p, donate_argnums=(0,))
+
+            def run(state, grads):
+                state["params"] = opt_step(state["params"], grads)
+                return state["params"]
+        """})
+    assert "GL003-donation-after-use" not in codes(fs)
+
+
+def test_donation_whole_container_read_after_field_donation(tmp_path):
+    """Reading the WHOLE container after one of its fields was donated
+    still touches the dead buffer (the container transitively holds
+    it)."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            opt_step = jax.jit(lambda p, g: p, donate_argnums=(0,))
+
+            def run(state, grads):
+                new_params = opt_step(state["params"], grads)
+                return new_params, state
+        """})
+    assert "GL003-donation-after-use" in codes(fs)
+
+
+def test_cross_module_field_donation_after_use(tmp_path):
+    """The graph replay carries field paths too: donor binding in one
+    module, field read in another."""
+    fs = lint_files(tmp_path, {
+        "trainer.py": """
+            import jax
+            opt_step = jax.jit(lambda p, g: p, donate_argnums=(0,))
+        """,
+        "driver.py": """
+            from trainer import opt_step
+            def run(state, grads):
+                new = opt_step(state["params"], grads)
+                stale = state["params"]
+                return new, stale
+        """})
+    got = [f for f in fs if f.rule == "GL003-donation-after-use"]
+    assert len(got) == 1
+    assert got[0].path.endswith("driver.py")
+
+
+# ------------------------------ gap 3: key= / non-first-positional kwargs
+
+
+def test_key_kwarg_consumption_counts(tmp_path):
+    """GL011 sees a helper that consumes its key through a key= kwarg in
+    non-first position — the r17 pass only tracked first-positional."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(key):
+                a = draw((4,), key=key)
+                b = draw((4,), key=key)
+                return a, b
+        """})
+    got = [f for f in fs if f.rule == "GL011-cross-module-key-reuse"]
+    assert len(got) == 1
+    assert "consumed more than once" in got[0].message
+
+
+def test_key_kwarg_split_keys_are_clean(tmp_path):
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(key):
+                k1, k2 = jax.random.split(key)
+                a = draw((4,), key=k1)
+                b = draw((4,), key=k2)
+                return a, b
+        """})
+    assert "GL011-cross-module-key-reuse" not in codes(fs)
+
+
+def test_container_field_key_reuse(tmp_path):
+    """A key living in a container field (state['rng']) consumed by a
+    proven consumer twice — field paths are tracked keys too."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(state):
+                a = draw((4,), key=state["rng"])
+                b = draw((4,), key=state["rng"])
+                return a, b
+        """})
+    got = [f for f in fs if f.rule == "GL011-cross-module-key-reuse"]
+    assert len(got) == 1
+    assert "state['rng']" in got[0].message
+
+
+def test_container_field_key_rebound_is_clean(tmp_path):
+    """Rebinding the field to an unrelated key between consumptions
+    kills the tracking — one consumption per key, no finding."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(state, fresh_key):
+                a = draw((4,), key=state["rng"])
+                state["rng"] = fresh_key
+                b = draw((4,), key=state["rng"])
+                return a, b
+        """})
+    assert "GL011-cross-module-key-reuse" not in codes(fs)
+
+
+# ----------------------- gap 4: dynamic dispatch through containers
+
+
+def test_dispatch_through_module_dict_const_key(tmp_path):
+    """A callable stored in a module dict under a constant key resolves;
+    the callee's derived sync is then reachable from traced code."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def loss_a(x):
+                return float(jnp.sum(x))
+
+            HANDLERS = {"a": loss_a}
+
+            def dispatch(batch):
+                @jax.jit
+                def step(b):
+                    fn = HANDLERS["a"]
+                    return fn(b)
+                return step(batch)
+        """})
+    got = [f for f in fs if f.rule == "GL002-host-sync"]
+    assert len(got) == 1
+
+
+def test_dispatch_through_unknown_table_is_silent(tmp_path):
+    """The table arrives as a parameter — unresolvable, widen to
+    silence (facts only ever proven)."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def loss_a(x):
+                return float(jnp.sum(x))
+
+            def dispatch(batch, table):
+                @jax.jit
+                def step(b):
+                    fn = table["a"]
+                    return fn(b)
+                return step(batch)
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_dispatch_through_constructor_kwarg_field(tmp_path):
+    """CFG = Cfg(step=loss_fn) at module level: the instance attribute
+    resolves through the constructor-kwarg points-to entry."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def loss_fn(x):
+                return float(jnp.sum(x))
+
+            class Cfg:
+                def __init__(self, step=None):
+                    self.step = step
+
+            CFG = Cfg(step=loss_fn)
+
+            def run(batch):
+                @jax.jit
+                def tick(b):
+                    return CFG.step(b)
+                return tick(batch)
+        """})
+    got = [f for f in fs if f.rule == "GL002-host-sync"]
+    assert len(got) == 1
+
+
+def test_dispatch_field_rebound_below_module_scope_widens(tmp_path):
+    """Any function storing into the tracked field kills the module-env
+    fact — the dispatch proves nothing afterwards."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def loss_fn(x):
+                return float(jnp.sum(x))
+
+            class Cfg:
+                def __init__(self, step=None):
+                    self.step = step
+
+            CFG = Cfg(step=loss_fn)
+
+            def rebind(other):
+                CFG.step = other
+
+            def run(batch):
+                @jax.jit
+                def tick(b):
+                    return CFG.step(b)
+                return tick(batch)
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_dispatch_registration_pattern_widens_table(tmp_path):
+    """HANDLERS[name] = fn anywhere below module scope widens the whole
+    table: runtime registration defeats the static proof, honestly."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def loss_a(x):
+                return float(jnp.sum(x))
+
+            HANDLERS = {"a": loss_a}
+
+            def register(name, fn):
+                HANDLERS[name] = fn
+
+            def dispatch(batch):
+                @jax.jit
+                def step(b):
+                    fn = HANDLERS["a"]
+                    return fn(b)
+                return step(batch)
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+def test_dispatch_through_getattr_is_silent(tmp_path):
+    """getattr(module, name) is dynamic — no candidates, no finding."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def run(batch, name):
+                fn = getattr(jnp, name)
+                @jax.jit
+                def tick(b):
+                    return fn(b)
+                return tick(batch)
+        """})
+    assert "GL002-host-sync" not in codes(fs)
+
+
+# --------------------------- GL011 branch replay: try/except, loop-else
+
+
+def test_key_retry_in_except_arm_is_clean(tmp_path):
+    """try-consume / except-consume are ALTERNATIVES — the retry pattern
+    must not read as double consumption."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(key):
+                try:
+                    return draw((4,), key=key)
+                except ValueError:
+                    return draw((2,), key=key)
+        """})
+    assert "GL011-cross-module-key-reuse" not in codes(fs)
+
+
+def test_key_consumed_in_try_body_and_after(tmp_path):
+    """Consumption in the try body survives the merge; a second
+    consumption after the statement is correlated randomness."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(key):
+                try:
+                    a = draw((4,), key=key)
+                except ValueError:
+                    a = None
+                b = draw((2,), key=key)
+                return a, b
+        """})
+    got = [f for f in fs if f.rule == "GL011-cross-module-key-reuse"]
+    assert len(got) == 1
+
+
+def test_key_in_loop_else_always_runs(tmp_path):
+    """A break-less for's else arm ALWAYS runs — consuming there plus
+    after the loop is double consumption."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(key, items):
+                for it in items:
+                    pass
+                else:
+                    a = draw((4,), key=key)
+                b = draw((2,), key=key)
+                return a, b
+        """})
+    got = [f for f in fs if f.rule == "GL011-cross-module-key-reuse"]
+    assert len(got) == 1
+
+
+def test_key_in_loop_else_with_break_is_exclusive(tmp_path):
+    """With a break in the loop, the else arm and the post-loop code are
+    exclusive paths — one consumption each, no finding."""
+    fs = lint_files(tmp_path, {
+        "mod.py": """
+            import jax
+
+            def draw(shape, key=None):
+                return jax.random.normal(key, shape)
+
+            def sample(key, items):
+                for it in items:
+                    if it:
+                        break
+                else:
+                    return draw((4,), key=key)
+                return draw((2,), key=key)
+        """})
+    assert "GL011-cross-module-key-reuse" not in codes(fs)
+
+
+# ------------------------------------------------- cache schema migration
+
+
+def test_cache_old_schema_entry_degrades_to_cold(tmp_path):
+    """A cache entry whose summary predates SUMMARY_SCHEMA (or is
+    garbled) must re-summarize cold — never crash, never trust."""
+    src = tmp_path / "mod.py"
+    src.write_text(textwrap.dedent("""
+        import jax
+        def f(rng):
+            a = jax.random.normal(rng, (2,))
+            b = jax.random.uniform(rng, (2,))
+            return a + b
+    """))
+    cache_path = tmp_path / "cache.json"
+    cache = AnalysisCache(str(cache_path))
+    first, _ = run_paths([str(src)], cache=cache)
+    assert "GL001-key-reuse" in codes(first)
+
+    # sabotage: rewrite every cached summary as an old-schema relic
+    blob = json.loads(cache_path.read_text())
+    for entry in blob["files"].values():
+        entry["summary"] = {"schema": 1, "path": "mod.py"}
+    cache_path.write_text(json.dumps(blob))
+
+    cache2 = AnalysisCache(str(cache_path))
+    again, _ = run_paths([str(src)], cache=cache2)
+    assert {f.fingerprint for f in again} == {f.fingerprint for f in first}
+
+    # garbled beyond schema: still a cold path, still no crash
+    blob = json.loads(cache_path.read_text())
+    for entry in blob["files"].values():
+        entry["summary"] = {"schema": "wat", "funcs": 7}
+    cache_path.write_text(json.dumps(blob))
+    cache3 = AnalysisCache(str(cache_path))
+    again2, _ = run_paths([str(src)], cache=cache3)
+    assert codes(again2) == codes(first)
+
+
+def test_summary_from_dict_is_total():
+    from distributed_pipeline_tpu.analysis.callgraph import (
+        SUMMARY_SCHEMA, ModuleSummary)
+
+    with pytest.raises(ValueError):
+        ModuleSummary.from_dict({"schema": SUMMARY_SCHEMA - 1})
+    with pytest.raises(ValueError):
+        ModuleSummary.from_dict({"schema": SUMMARY_SCHEMA})  # no fields
+    with pytest.raises(ValueError):
+        ModuleSummary.from_dict([])  # not even a dict
+    full = {"schema": SUMMARY_SCHEMA, "path": "x.py", "modname": "x",
+            "is_package": False, "aliases": {}, "funcs": {},
+            "classes": {}, "jit_bindings": {}, "partials": {},
+            "local_donations": [], "local_jitted": [],
+            "traced_refs": []}
+    s = ModuleSummary.from_dict(full)
+    assert s.path == "x.py" and s.funcs == {}
+    # round-trip: to_dict -> from_dict is identity on the dict form
+    assert ModuleSummary.from_dict(s.to_dict()).to_dict() == s.to_dict()
+    # dropping any one required field is a schema violation, not a crash
+    for k in ("path", "funcs", "aliases"):
+        broken = {kk: v for kk, v in full.items() if kk != k}
+        with pytest.raises(ValueError):
+            ModuleSummary.from_dict(broken)
+
+
+# --------------------------------------- runtime-evidence bridge (GL013)
+
+
+def _write_report(run_dir, violations):
+    run_dir.mkdir(parents=True, exist_ok=True)
+    (run_dir / "sanitize_report.json").write_text(json.dumps(
+        {"version": 1, "violations": violations}))
+
+
+def test_sanitize_report_roundtrip(tmp_path):
+    from distributed_pipeline_tpu.utils.perf import (
+        SANITIZE_REPORT_NAME, SanitizeReport)
+
+    rep = SanitizeReport()
+    rep.record("transfer_guard", detail="boom",
+               site={"path": "/a/b.py", "line": 7, "func": "f",
+                     "snippet": "x = y"})
+    out = rep.write(str(tmp_path))
+    assert out.endswith(SANITIZE_REPORT_NAME)
+    blob = json.loads((tmp_path / SANITIZE_REPORT_NAME).read_text())
+    v = blob["violations"][0]
+    assert v["kind"] == "transfer_guard" and v["line"] == 7
+    assert v["path"] == "/a/b.py" and v["detail"] == "boom"
+
+
+def test_sanitize_guard_records_real_trip(tmp_path):
+    """A numpy array reaching a jitted call under the guard trips the
+    transfer guard; the violation must carry THIS file as its site and
+    the exception must still propagate."""
+    import numpy as np
+    import jax
+
+    from distributed_pipeline_tpu.utils.perf import SanitizeReport
+
+    rep = SanitizeReport(default_dir=str(tmp_path))
+    jitted = jax.jit(lambda x: x * 2)
+    with pytest.raises(Exception, match="isallow"):
+        with rep.guard():
+            jitted(np.ones(3))
+    assert len(rep.violations) == 1
+    v = rep.violations[0]
+    assert v["kind"] == "transfer_guard"
+    assert v["path"].endswith("test_analysis.py")
+    assert (tmp_path / "sanitize_report.json").exists()  # auto-write
+
+
+def test_runtime_evidence_flags_statically_clean_site(tmp_path, capsys):
+    """The acceptance e2e: a planted transfer-guard trip at a site the
+    static pass cleared surfaces as GL013 and fails the lint."""
+    clean = tmp_path / "clean_mod.py"
+    clean.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def step(x):
+            return jnp.sum(x * 2.0)
+    """))
+    _write_report(tmp_path / "run", [{
+        "kind": "transfer_guard", "path": str(clean), "line": 5,
+        "func": "step", "snippet": "return jnp.sum(x * 2.0)",
+        "detail": "Disallowed host-to-device transfer"}])
+    rc = cli_main([str(clean), "--no-cache", "--baseline", "none",
+                   "--format", "json",
+                   "--runtime-evidence", str(tmp_path / "run")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    got = [f for f in out["findings"]
+           if f["rule"] == "GL013-runtime-coverage-gap"]
+    assert len(got) == 1
+    assert got[0]["line"] == 5
+    assert "transfer" in got[0]["message"]
+
+
+def test_runtime_evidence_covered_site_is_quiet(tmp_path, capsys):
+    """A violation at a line the static pass ALREADY flags is covered —
+    the linter told the user; no GL013."""
+    dirty = tmp_path / "dirty_mod.py"
+    dirty.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    """))
+    fs, _ = run_paths([str(dirty)])
+    sync = next(f for f in fs if f.rule == "GL002-host-sync")
+    _write_report(tmp_path / "run", [{
+        "kind": "transfer_guard", "path": str(dirty), "line": sync.line,
+        "func": "f", "snippet": "", "detail": "trip"}])
+    rc = cli_main([str(dirty), "--no-cache", "--baseline", "none",
+                   "--format", "json",
+                   "--runtime-evidence", str(tmp_path / "run")])
+    out = json.loads(capsys.readouterr().out)
+    assert all(f["rule"] != "GL013-runtime-coverage-gap"
+               for f in out["findings"])
+    assert rc == 1  # the GL002 itself still fails the lint
+
+
+def test_runtime_evidence_missing_report_is_usage_error(tmp_path, capsys):
+    clean = tmp_path / "mod.py"
+    clean.write_text("x = 1\n")
+    rc = cli_main([str(clean), "--no-cache", "--baseline", "none",
+                   "--runtime-evidence", str(tmp_path / "nowhere")])
+    assert rc == 2
+
+
+def test_note_recompiles_steady_boundary(tmp_path):
+    """Compiles up to the steady boundary are warmup; only the ones
+    after it become violations, each at its captured user site."""
+    import logging
+
+    from distributed_pipeline_tpu.utils.perf import (
+        RecompileMonitor, SanitizeReport)
+
+    def compile_record(name):
+        return logging.LogRecord(
+            "jax", logging.WARNING, __file__, 1,
+            f"Compiling {name} because shape changed", None, None)
+
+    mon = RecompileMonitor(capture_sites=True)
+    for i in range(3):
+        mon.emit(compile_record(f"f{i}"))
+    assert mon.count == 3 and len(mon.sites) == 3
+
+    rep = SanitizeReport()
+    rep.note_recompiles(mon, steady_after=1)  # first compile = warmup
+    assert len(rep.violations) == 2
+    assert all(v["kind"] == "steady_recompile" for v in rep.violations)
+    assert all(v["path"].endswith("test_analysis.py")
+               for v in rep.violations)
+
+    # site-less monitor still leaves (unlocatable) evidence
+    bare = RecompileMonitor()
+    bare.emit(compile_record("g"))
+    bare.emit(compile_record("h"))
+    rep2 = SanitizeReport()
+    rep2.note_recompiles(bare, steady_after=1)
+    assert len(rep2.violations) == 1 and not rep2.violations[0]["path"]

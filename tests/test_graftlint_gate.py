@@ -103,6 +103,27 @@ def test_package_lints_clean_against_baseline(gate_run):
         f"{report}")
 
 
+def test_lint_gate_script_runs_clean():
+    """scripts/lint_gate.sh is the CI entry point: the changed-files
+    annotation pass plus the cached whole-program pass, gated paths
+    imported from THIS module so the two gates cannot drift. It must
+    exit 0 on the current tree."""
+    import subprocess
+    import sys
+
+    script = os.path.join(ROOT, "scripts", "lint_gate.sh")
+    assert os.path.exists(script)
+    proc = subprocess.run(
+        ["bash", script], cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "PYTHON": sys.executable}, timeout=300)
+    assert proc.returncode == 0, (
+        f"lint_gate.sh failed (rc={proc.returncode}):\n"
+        f"{proc.stdout}\n{proc.stderr}")
+    # the whole-program pass reported, against the committed baseline
+    assert "OK" in proc.stderr + proc.stdout
+    assert "graftlint_baseline.json" in proc.stderr + proc.stdout
+
+
 def test_baseline_has_no_stale_entries(gate_run):
     """Entries whose finding no longer exists are audit debt: the flagged
     line changed or was fixed, so the entry vouches for nothing. Keeps
