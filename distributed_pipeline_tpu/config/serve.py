@@ -88,6 +88,32 @@ class ServeSettings(S):
                 "through a flash-decode kernel (no gathered copy); 'xla' "
                 "is the gather+dot reference; 'auto' picks pallas on TPU "
                 "and xla elsewhere")
+    kv_quant: Literal["fp", "int8"] = _(
+        "fp", "paged KV pool storage (ISSUE 20): 'int8' quantizes K/V at "
+              "page granularity with [P] fp32 per-page scales — pool "
+              "bytes drop ~4x (f32) / ~2x (bf16), so decode slots and "
+              "prefix-cache capacity double at fixed HBM; decode logits "
+              "carry the documented divergence bound instead of "
+              "bit-identity (prefill logits are unchanged)")
+    spec_tokens: int = _(0, "speculative decoding (ISSUE 20): draft K "
+                            "tokens per round and verify them in ONE "
+                            "target dispatch; greedy output is token-"
+                            "identical to the non-speculative path. "
+                            "0 = off")
+    spec_draft: Literal["ngram", "model"] = _(
+        "ngram", "draft source: 'ngram' = host-side prompt-lookup "
+                 "(zero model flops — the CPU-friendly arm); 'model' = "
+                 "early-exit engine over the target's first draft_layers "
+                 "blocks (weights shared, no training)")
+    draft_layers: int = _(2, "spec_draft='model': how many leading target "
+                             "blocks the draft model keeps")
+    serve_quant: Literal["off", "int8"] = _(
+        "off", "quantize replica WEIGHTS at load and at every hot-swap "
+               "restore (serving/quantize.py): int8 storage round-trip "
+               "with per-channel scales and a round-trip error guard — "
+               "a corrupt/pathological checkpoint raises inside the "
+               "worker, so the hot-swap canary aborts instead of the "
+               "fleet taking bad weights")
     prefix_cache: bool = _(False, "shared-prefix KV page reuse: requests "
                                   "whose prompts open with the same token "
                                   "run share the paged-KV pages holding "

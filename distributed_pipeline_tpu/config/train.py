@@ -199,14 +199,16 @@ class MeshSettings(S):
                "optimizer/EMA memory drops ~dp x at unchanged step math "
                "(params/grads keep their layout; checkpoints restore "
                "across the flag in either direction)")
-    fused_update: bool = _(
-        False, "fused optimizer+EMA Pallas kernel (ops/fused_update.py): "
-               "one pass per param leaf reads param/grad/mu/nu and writes "
-               "param/mu/nu plus every EMA copy, replacing the staged "
-               "optax chain that re-reads the tree once per state copy; "
-               "losses bit-identical, opt_state structure unchanged "
-               "(checkpoints and --shard_optimizer compose either way); "
-               "off-TPU it runs in Pallas interpreter mode")
+    fused_update: str = _(
+        "auto", "fused optimizer+EMA Pallas kernel (ops/fused_update.py): "
+                "one pass per param leaf reads param/grad/mu/nu and writes "
+                "param/mu/nu plus every EMA copy, replacing the staged "
+                "optax chain that re-reads the tree once per state copy; "
+                "losses bit-identical, opt_state structure unchanged "
+                "(checkpoints and --shard_optimizer compose either way). "
+                "auto (default) = fused on TPU, staged optax elsewhere "
+                "(off-TPU the kernel only has interpreter mode, which is "
+                "pure overhead); true/false force an arm")
     partition_rules: str = _(
         "", "override the model's parameter partition-rule table "
             "(parallel/partition.py): inline JSON, @/path.json, or a bare "

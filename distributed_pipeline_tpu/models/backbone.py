@@ -73,6 +73,12 @@ class SelfAttention(nn.Module):
     # on TPU / XLA gather elsewhere; "pallas"/"xla" force. Distinct from
     # attention_impl, which picks the full-sequence (train/prefill) kernel.
     decode_impl: str = "auto"
+    # "int8": store the paged pool quantized per page with [P] fp32 scale
+    # sidecars (serving/paged_kv.py q8 writers) — halves pool bytes; decode
+    # reads dequantize per page. Prefill attention still runs on the local
+    # fp k/v, so prefill logits are unchanged; decode logits carry the
+    # documented quantization divergence instead of bit-identity.
+    kv_quant: str = "fp"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -111,28 +117,81 @@ class SelfAttention(nn.Module):
         # function-level import: paged_kv is a leaf module (jax-only), so
         # models <- serving here is a cycle-free convenience, same pattern
         # as Block's moe import
-        from ..ops.flash_decode import paged_decode_attention
-        from ..serving.paged_kv import write_prompt_kv, write_token_kv
+        from ..ops.flash_decode import (paged_decode_attention,
+                                        paged_span_attention)
+        from ..serving.paged_kv import (write_prompt_kv, write_prompt_kv_q8,
+                                        write_span_kv, write_span_kv_q8,
+                                        write_token_kv, write_token_kv_q8)
         B, H, L, Dh = q.shape
+        quant = self.kv_quant == "int8"
+        pool_dtype = jnp.int8 if quant else k.dtype
         pk = self.variable("cache", "pages_k", jnp.zeros,
-                           (self.paged_pages, self.page_size, H, Dh), k.dtype)
+                           (self.paged_pages, self.page_size, H, Dh),
+                           pool_dtype)
         pv = self.variable("cache", "pages_v", jnp.zeros,
-                           (self.paged_pages, self.page_size, H, Dh), v.dtype)
-        if L > 1:  # prefill: write the prompt's K/V into its slots' pages;
+                           (self.paged_pages, self.page_size, H, Dh),
+                           pool_dtype)
+        sk = sv = None
+        if quant:  # [P] per-page fp32 scale sidecars
+            sk = self.variable("cache", "scales_k", jnp.zeros,
+                               (self.paged_pages,), jnp.float32)
+            sv = self.variable("cache", "scales_v", jnp.zeros,
+                               (self.paged_pages,), jnp.float32)
+        if L > 1 and cache_index is None:
+            # prefill: write the prompt's K/V into its slots' pages;
             # attention itself runs on the local (contiguous) k/v — exactly
             # the dense prefill computation, so logits match it bitwise
+            # (int8 included: quantization touches only the POOL copy)
             valid = pad_mask if pad_mask is not None else jnp.ones(
                 (B, L), jnp.int32)
-            pk.value = write_prompt_kv(pk.value, block_table, k, valid)
-            pv.value = write_prompt_kv(pv.value, block_table, v, valid)
+            if quant:
+                pk.value, sk.value = write_prompt_kv_q8(
+                    pk.value, sk.value, block_table, k, valid)
+                pv.value, sv.value = write_prompt_kv_q8(
+                    pv.value, sv.value, block_table, v, valid)
+            else:
+                pk.value = write_prompt_kv(pk.value, block_table, k, valid)
+                pv.value = write_prompt_kv(pv.value, block_table, v, valid)
             return dot_product_attention(q, k, v, pad_mask, causal=True,
                                          impl=self.attention_impl)
         if cache_index is None or jnp.ndim(cache_index) != 1:
-            raise ValueError("paged single-token decode needs a per-slot "
-                             "cache_index vector [B]")
+            raise ValueError("paged decode needs a per-slot cache_index "
+                             "vector [B]")
         idx = jnp.asarray(cache_index, jnp.int32)
-        pk.value = write_token_kv(pk.value, block_table, k[:, :, 0], idx)
-        pv.value = write_token_kv(pv.value, block_table, v[:, :, 0], idx)
+        if L > 1:
+            # speculative-verify span (serving/engine.verify_fn): each
+            # slot's L chain links occupy positions idx..idx+L-1. Write
+            # every link's K/V first (span writers clamp budget-final
+            # overshoot to the last addressable cell), then one span
+            # attention dispatch: link j's query sits at position idx+j
+            # and its position mask reads the live prefix PLUS the
+            # earlier links — exactly the rows a sequential K+1-step
+            # replay would read, at the op count of ONE decode step.
+            if quant:
+                pk.value, sk.value = write_span_kv_q8(
+                    pk.value, sk.value, block_table, k, idx)
+                pv.value, sv.value = write_span_kv_q8(
+                    pv.value, sv.value, block_table, v, idx)
+            else:
+                pk.value = write_span_kv(pk.value, block_table, k, idx)
+                pv.value = write_span_kv(pv.value, block_table, v, idx)
+            addr = block_table.shape[1] * self.page_size
+            pos = jnp.minimum(idx[:, None]
+                              + jnp.arange(L, dtype=jnp.int32)[None, :],
+                              addr - 1)                          # [B, L]
+            return paged_span_attention(
+                q, pk.value, pv.value, block_table, pos,
+                impl=self.decode_impl,
+                scales_k=sk.value if quant else None,
+                scales_v=sv.value if quant else None)
+        if quant:
+            pk.value, sk.value = write_token_kv_q8(
+                pk.value, sk.value, block_table, k[:, :, 0], idx)
+            pv.value, sv.value = write_token_kv_q8(
+                pv.value, sv.value, block_table, v[:, :, 0], idx)
+        else:
+            pk.value = write_token_kv(pk.value, block_table, k[:, :, 0], idx)
+            pv.value = write_token_kv(pv.value, block_table, v[:, :, 0], idx)
         # The decode_step seam: positions beyond each slot's own depth hold
         # trash/stale pages and are masked (causality IS this mask for one
         # query row). The XLA path gathers a dense [B, H, Lmax, Dh] view
@@ -140,8 +199,11 @@ class SelfAttention(nn.Module):
         # padded length; the pallas path (ops/flash_decode.py) reads live
         # pages straight from the pool, matching to float tolerance
         # (greedy-token identical — tests/test_kernels.py).
-        o = paged_decode_attention(q[:, :, 0], pk.value, pv.value,
-                                   block_table, idx, impl=self.decode_impl)
+        o = paged_decode_attention(
+            q[:, :, 0], pk.value, pv.value, block_table, idx,
+            impl=self.decode_impl,
+            scales_k=sk.value if quant else None,
+            scales_v=sv.value if quant else None)
         return o[:, :, None]
 
     def _cached_attention(self, q, k, v, pad_mask, cache_index):
@@ -213,6 +275,7 @@ class Block(nn.Module):
     paged_pages: int = 0
     page_size: int = 0
     decode_impl: str = "auto"
+    kv_quant: str = "fp"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -225,6 +288,7 @@ class Block(nn.Module):
                               paged_pages=self.paged_pages,
                               page_size=self.page_size,
                               decode_impl=self.decode_impl,
+                              kv_quant=self.kv_quant,
                               name="attn")(h, pad_mask, cache_index,
                                            block_table)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -266,6 +330,7 @@ class TransformerBackbone(nn.Module):
     paged_pages: int = 0  # serving: paged KV cache pool size (0 = dense)
     page_size: int = 0
     decode_impl: str = "auto"  # paged decode-step kernel (SelfAttention)
+    kv_quant: str = "fp"  # "int8": quantized page pool + per-page scales
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -318,6 +383,7 @@ class TransformerBackbone(nn.Module):
                           paged_pages=self.paged_pages,
                           page_size=self.page_size,
                           decode_impl=self.decode_impl,
+                          kv_quant=self.kv_quant,
                           name=f"block_{i}")(x, pad_mask, cache_index,
                                              block_table)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x).astype(self.dtype)

@@ -50,6 +50,7 @@ class GPT2Model(nn.Module):
     paged_pages: int = 0  # serving: paged KV-cache pool size (0 = dense)
     page_size: int = 0
     decode_impl: str = "auto"  # paged decode-step kernel (flash-decode/xla)
+    kv_quant: str = "fp"  # "int8": quantized page pool + per-page scales
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray,
@@ -78,6 +79,15 @@ class GPT2Model(nn.Module):
                 # per-slot positions (continuous-batching decode): each
                 # slot sits at its own depth, so the embedding is a gather
                 pos = jnp.take(pos_emb, idx, axis=0)[:, None, :]
+        elif cache_index is not None:
+            # speculative-verify span: per-slot chains at idx..idx+L-1
+            # (backbone span branch); budget-final overshoot clamps to
+            # the table edge — those links' picks are discarded anyway
+            idx = jnp.asarray(cache_index, jnp.int32)
+            span = jnp.minimum(idx[:, None]
+                               + jnp.arange(L, dtype=jnp.int32)[None, :],
+                               self.seq_len - 1)
+            pos = jnp.take(pos_emb, span, axis=0)        # [B, L, D]
         else:
             pos = pos_emb[None, :L]
         h = (word_emb(ids) + pos).astype(self.dtype)
@@ -98,6 +108,7 @@ class GPT2Model(nn.Module):
                                 paged_pages=self.paged_pages,
                                 page_size=self.page_size,
                                 decode_impl=self.decode_impl,
+                                kv_quant=self.kv_quant,
                                 name="backbone")(h, pad_mask, cache_index,
                                                  block_table)
         # Tied LM head in compute dtype: bf16 [B, L, V] logits cost half the

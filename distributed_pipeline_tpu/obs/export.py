@@ -567,6 +567,16 @@ def _prom_ledger(p: _Prom, run_dir: str,
               help_="HLO-tallied collective payload per step")
         p.add("dpt_padding_waste_frac", row.get("padding_waste_frac"),
               lab, help_="share of step tokens that are padding")
+        if row.get("accept_rate") is not None:
+            # speculative-decoding gauges (ISSUE 20): only the decode
+            # program of a --spec_tokens replica/run carries them
+            p.add("dpt_accept_rate", row.get("accept_rate"), lab,
+                  help_="draft-token acceptance rate under speculative "
+                        "decoding (perf_ledger.json)")
+            p.add("dpt_accepted_tokens_per_s",
+                  row.get("accepted_tokens_per_s"), lab,
+                  help_="target-verified tokens per second under "
+                        "speculative decoding")
 
 
 def _prom_fleet(p: _Prom, fleet_dir: str, now: float) -> None:
@@ -599,6 +609,16 @@ def _prom_fleet(p: _Prom, fleet_dir: str, now: float) -> None:
                           {**lab, "category": cat[:-2]},
                           help_="in-attempt serving-time decomposition "
                                 "from the replica's beacon")
+            if b.get("accept_rate") is not None:
+                # live speculative gauges off the beacon (no --cost_ledger
+                # needed): same names the ledger path emits per program
+                p.add("dpt_accept_rate", b.get("accept_rate"), lab,
+                      help_="draft-token acceptance rate under "
+                            "speculative decoding (perf_ledger.json)")
+                p.add("dpt_accepted_tokens_per_s",
+                      b.get("accepted_tokens_per_s"), lab,
+                      help_="target-verified tokens per second under "
+                            "speculative decoding")
             if b.get("prefix_hits") is not None:
                 p.add("dpt_replica_prefix_cache_total",
                       b.get("prefix_hits"), {**lab, "kind": "hit"},

@@ -40,9 +40,15 @@ this kernel later):
   two slots listing the same page id just schedule two DMAs of it;
 * on real TPU the ``(H, Dh)`` trailing dims of a page block must tile the
   ``(8, 128)`` f32 layout; pools that don't (small models) dispatch to the
-  XLA path under ``impl="auto"`` — see :func:`resolve_decode_impl`. Int8
-  pages will need ``(32, 128)`` tiles and a dequant in ``_compute``; the
-  schedule and contract above are unchanged.
+  XLA path under ``impl="auto"`` — see :func:`resolve_decode_impl`;
+* int8 pools (serving/paged_kv.py ``write_*_kv_q8``) ride the SAME schedule:
+  each page's fp32 scale is bitcast to int32 and appended to its step row
+  (columns 7..8, K and V scales), so the scale arrives with the scalar
+  prefetch and the kernel dequantizes the DMA'd page in VMEM
+  (``page.astype(f32) * scale``) before the dot — no second gather, no
+  extra HBM traffic beyond the 8-byte-per-page scale pair. On real TPU
+  int8 page blocks want ``(32, 128)`` tiles; small-model pools again fall
+  back to the XLA arm, which dequantizes after ``gather_kv``.
 
 Dispatch: ``impl="auto"`` -> this kernel on TPU (layout permitting), the
 XLA gather path elsewhere; ``"pallas"`` forces the kernel (interpreter
@@ -75,8 +81,9 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["flash_decode", "paged_decode_attention", "resolve_decode_impl",
-           "decode_hbm_bytes", "xla_paged_decode"]
+__all__ = ["flash_decode", "paged_decode_attention", "paged_span_attention",
+           "resolve_decode_impl", "decode_hbm_bytes", "xla_paged_decode",
+           "xla_paged_span_decode"]
 
 NEG_INF = -1e9
 LANES = 128
@@ -106,10 +113,13 @@ def resolve_decode_impl(impl: str, page_shape=None) -> str:
 
 
 def _build_steps(block_table: jnp.ndarray, positions: jnp.ndarray,
-                 page_size: int, n_slots: int) -> jnp.ndarray:
+                 page_size: int, n_slots: int, scales_k=None,
+                 scales_v=None) -> jnp.ndarray:
     """Traced ``[B * n_pages, 7]`` step table (module docstring): live rows
     packed first, slot-major; dead rows route to (slot=B, trash page,
-    pos=-1) so they mask to zero and re-DMA nothing on TPU."""
+    pos=-1) so they mask to zero and re-DMA nothing on TPU. With int8
+    scales the table widens to 9 columns: each row carries its page's K and
+    V scales as bitcast int32, gathered through the block table."""
     B, n = block_table.shape
     pos = positions.astype(jnp.int32)
     n_live = jnp.minimum(pos // page_size + 1, n)              # [B]
@@ -128,16 +138,22 @@ def _build_steps(block_table: jnp.ndarray, positions: jnp.ndarray,
         return jnp.where(dsel == 1, fill,
                          x.reshape(-1)[order]).astype(jnp.int32)
 
-    return jnp.stack([
+    cols = [
         pack(slot, n_slots), pack(block_table, TRASH_PAGE),
         pack(first.astype(jnp.int32), 1), pack(last.astype(jnp.int32), 1),
         # needs_mask == last: only a slot's final page is partially live
         pack(last.astype(jnp.int32), 1),
-        pack(base, 0), pack(posb, -1)], axis=1)
+        pack(base, 0), pack(posb, -1)]
+    if scales_k is not None:
+        for sc in (scales_k, scales_v):
+            bits = jax.lax.bitcast_convert_type(
+                sc.astype(jnp.float32), jnp.int32)[block_table]   # [B, n]
+            cols.append(pack(bits, 0))  # dead rows: scale 0 -> dequant to 0
+    return jnp.stack(cols, axis=1)
 
 
 def _decode_kernel(steps_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, scale: float):
+                   acc_ref, m_ref, l_ref, *, scale: float, quant: bool):
     t = pl.program_id(0)
 
     @pl.when(steps_ref[t, 2] == 1)
@@ -149,6 +165,11 @@ def _decode_kernel(steps_ref, q_ref, k_ref, v_ref, o_ref,
     q = q_ref[0]                    # [H, Dh]
     k = k_ref[0]                    # [page_size, H, Dh]
     v = v_ref[0]
+    if quant:  # int8 page + per-page scale riding the step table (bitcast)
+        sk = jax.lax.bitcast_convert_type(steps_ref[t, 7], jnp.float32)
+        sv = jax.lax.bitcast_convert_type(steps_ref[t, 8], jnp.float32)
+        k = k.astype(jnp.float32) * sk
+        v = v.astype(jnp.float32) * sv
     # s[h, t] = q[h, :] . k[t, h, :]: head-batched single-query scores
     s = jax.lax.dot_general(
         q, k, (((1,), (2,)), ((0,), (1,))),
@@ -189,18 +210,22 @@ def _decode_kernel(steps_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def flash_decode(q: jnp.ndarray, pages_k: jnp.ndarray, pages_v: jnp.ndarray,
-                 block_table: jnp.ndarray,
-                 positions: jnp.ndarray) -> jnp.ndarray:
+                 block_table: jnp.ndarray, positions: jnp.ndarray,
+                 scales_k=None, scales_v=None) -> jnp.ndarray:
     """Paged single-query attention: ``q`` [B, H, Dh], pool
     ``[P, page_size, H, Dh]``, ``block_table`` [B, n_pages], ``positions``
     [B] -> [B, H, Dh]. Attends positions ``0..positions[b]`` of each slot
     through its block table; everything later is skipped at schedule level.
-    """
+    ``scales_k``/``scales_v`` ([P] fp32) flag an int8 pool: the kernel
+    dequantizes each DMA'd page with its scale from the step table."""
     if pltpu is None:  # pragma: no cover — CPU wheels without pallas-TPU
-        return xla_paged_decode(q, pages_k, pages_v, block_table, positions)
+        return xla_paged_decode(q, pages_k, pages_v, block_table, positions,
+                                scales_k, scales_v)
     B, H, Dh = q.shape
     _, page_size, _, _ = pages_k.shape
-    steps = _build_steps(block_table, positions, page_size, B)
+    quant = scales_k is not None
+    steps = _build_steps(block_table, positions, page_size, B,
+                         scales_k, scales_v)
     # Row B is the dead-step sink: zero query in, garbage-free zeros out.
     qp = jnp.concatenate([q, jnp.zeros((1, H, Dh), q.dtype)], axis=0)
     n_steps = steps.shape[0]
@@ -223,7 +248,7 @@ def flash_decode(q: jnp.ndarray, pages_k: jnp.ndarray, pages_v: jnp.ndarray,
             _VMEM((H, LANES), jnp.float32),   # running normalizer
         ])
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=Dh ** -0.5),
+        functools.partial(_decode_kernel, scale=Dh ** -0.5, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B + 1, H, Dh), q.dtype),
         interpret=_interpret())(steps, qp, pages_k, pages_v)
@@ -232,13 +257,19 @@ def flash_decode(q: jnp.ndarray, pages_k: jnp.ndarray, pages_v: jnp.ndarray,
 
 def xla_paged_decode(q: jnp.ndarray, pages_k: jnp.ndarray,
                      pages_v: jnp.ndarray, block_table: jnp.ndarray,
-                     positions: jnp.ndarray) -> jnp.ndarray:
+                     positions: jnp.ndarray, scales_k=None,
+                     scales_v=None) -> jnp.ndarray:
     """The gather-path twin ([B, H, Dh] in/out), kept callable standalone so
-    the bench leg can cost-analyze the seam it replaces."""
-    from ..serving.paged_kv import gather_kv
+    the bench leg can cost-analyze the seam it replaces. int8 pools
+    (``scales_*`` given) are dequantized right after the gather."""
+    from ..serving.paged_kv import dequant_gathered, gather_kv
     from .attention import dot_product_attention
     ks = gather_kv(pages_k, block_table)        # [B, H, n*page_size, Dh]
     vs = gather_kv(pages_v, block_table)
+    if scales_k is not None:
+        ps = pages_k.shape[1]
+        ks = dequant_gathered(ks, scales_k, block_table, ps, q.dtype)
+        vs = dequant_gathered(vs, scales_v, block_table, ps, q.dtype)
     live = (jnp.arange(ks.shape[2])[None, :]
             <= positions[:, None]).astype(jnp.int32)
     o = dot_product_attention(q[:, :, None], ks, vs, live, causal=False,
@@ -247,42 +278,106 @@ def xla_paged_decode(q: jnp.ndarray, pages_k: jnp.ndarray,
 
 
 def paged_decode_attention(q, pages_k, pages_v, block_table, positions,
-                           impl: str = "auto") -> jnp.ndarray:
+                           impl: str = "auto", scales_k=None,
+                           scales_v=None) -> jnp.ndarray:
     """The decode-step seam: dispatch one generated token's attention.
 
     ``q`` [B, H, Dh]; returns [B, H, Dh]. The caller has already written
-    the token's K/V into the pool (page-layout contract)."""
+    the token's K/V into the pool (page-layout contract); for int8 pools it
+    passes the [P] scale sidecars and both arms dequantize."""
     if resolve_decode_impl(impl, pages_k.shape) == "pallas":
-        return flash_decode(q, pages_k, pages_v, block_table, positions)
-    return xla_paged_decode(q, pages_k, pages_v, block_table, positions)
+        return flash_decode(q, pages_k, pages_v, block_table, positions,
+                            scales_k, scales_v)
+    return xla_paged_decode(q, pages_k, pages_v, block_table, positions,
+                            scales_k, scales_v)
+
+
+def xla_paged_span_decode(q: jnp.ndarray, pages_k: jnp.ndarray,
+                          pages_v: jnp.ndarray, block_table: jnp.ndarray,
+                          positions: jnp.ndarray, scales_k=None,
+                          scales_v=None) -> jnp.ndarray:
+    """Span (speculative-verify) twin of :func:`xla_paged_decode`.
+
+    ``q`` [B, H, L, Dh] holds each slot's L chain links; ``positions``
+    [B, L] their per-link depths. Gathers each slot's dense view ONCE —
+    the pseudo-slot formulation (L repeated block-table rows through the
+    single-token path) re-gathers the same pages L times, and on the XLA
+    arm that gather traffic dominated the verify dispatch. Per link the
+    math mirrors xla_paged_decode's exactly (same einsum contractions,
+    same NEG_INF additive bias in the logits dtype, same f32 softmax), so
+    a span link's output is bitwise the single-token output at the same
+    position — the spec-decode identity contract rides on this."""
+    from ..serving.paged_kv import dequant_gathered, gather_kv
+    ks = gather_kv(pages_k, block_table)        # [B, H, n*page_size, Dh]
+    vs = gather_kv(pages_v, block_table)
+    if scales_k is not None:
+        ps = pages_k.shape[1]
+        ks = dequant_gathered(ks, scales_k, block_table, ps, q.dtype)
+        vs = dequant_gathered(vs, scales_v, block_table, ps, q.dtype)
+    dh = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, ks) * jnp.asarray(
+        dh ** -0.5, q.dtype)
+    live = (jnp.arange(ks.shape[2])[None, None, :]
+            <= positions[:, :, None]).astype(jnp.int32)   # [B, L, Lmax]
+    logits = logits + (1 - live[:, None]).astype(logits.dtype) * NEG_INF
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, vs)
+
+
+def paged_span_attention(q, pages_k, pages_v, block_table, positions,
+                         impl: str = "auto", scales_k=None,
+                         scales_v=None) -> jnp.ndarray:
+    """The speculative-verify span seam: one dispatch attends a whole
+    draft chain. ``q`` [B, H, L, Dh], ``positions`` [B, L]; returns
+    [B, H, L, Dh]. The caller has already written every link's K/V into
+    the pool. The pallas arm runs the flash decode kernel over B*L
+    pseudo-slots (each link repeats its slot's block-table row); the XLA
+    arm gathers each slot once and masks per link."""
+    B, H, L, Dh = q.shape
+    if resolve_decode_impl(impl, pages_k.shape) == "pallas":
+        qf = q.transpose(0, 2, 1, 3).reshape(B * L, H, Dh)
+        bt = jnp.repeat(block_table, L, axis=0)
+        o = flash_decode(qf, pages_k, pages_v, bt, positions.reshape(-1),
+                         scales_k, scales_v)
+        return o.reshape(B, L, H, Dh).transpose(0, 2, 1, 3)
+    return xla_paged_span_decode(q, pages_k, pages_v, block_table,
+                                 positions, scales_k, scales_v)
 
 
 def decode_hbm_bytes(block_table: np.ndarray, positions: np.ndarray,
                      page_size: int, n_heads: int, head_dim: int,
-                     dtype_bytes: int = 4) -> int:
+                     dtype_bytes: int = 4, kv_dtype_bytes=None,
+                     quantized: bool = False) -> int:
     """Exact HBM bytes one kernel invocation DMAs, from its own schedule.
 
-    Counts, per live step, the K and V page blocks (re-fetches of the page
-    just visited are free: consecutive identical index-map outputs skip the
-    DMA, which also zero-rates the packed dead tail), plus one q read and
-    one output write per slot run and the SMEM step table. This is the
-    TPU lowering's traffic by construction of the grid spec; the bench leg
-    uses it as the kernel-arm number because interpreter mode cannot be
-    cost-analyzed faithfully (module docstring)."""
+    Counts each DISTINCT live page's K and V blocks once across the whole
+    schedule — the schedule visits pages slot-major, so a page shared by
+    many slots (PrefixCache) or revisited consecutively is fetched once;
+    dedup is by page-id set, which also zero-rates the packed dead tail.
+    (The pre-r22 census deduped only consecutive-identical visits, which
+    under-credited the kernel on shared-prefix workloads where the same
+    prefix pages appear in every slot's run.) Adds one q read and one
+    output write per slot and the SMEM step table. ``kv_dtype_bytes``
+    prices the pool separately from q/out (int8 pools: 1 vs 4);
+    ``quantized`` widens the table to 9 columns — the per-page scale pair
+    rides it, so it costs table bytes, not extra page traffic."""
     bt = np.asarray(block_table)
     pos = np.asarray(positions)
     B, n = bt.shape
-    page_bytes = page_size * n_heads * head_dim * dtype_bytes
+    if kv_dtype_bytes is None:
+        kv_dtype_bytes = 1 if quantized else dtype_bytes
+    page_bytes = page_size * n_heads * head_dim * kv_dtype_bytes
     qo_bytes = n_heads * head_dim * dtype_bytes
     n_live = np.minimum(pos // page_size + 1, n)
     total = 0
-    prev_page = None
+    seen: set = set()
     for b in range(B):
         for j in range(int(n_live[b])):
             page = int(bt[b, j])
-            if page != prev_page:
+            if page not in seen:
                 total += 2 * page_bytes            # K and V blocks
-            prev_page = page
+                seen.add(page)
         total += 2 * qo_bytes                      # q read + out write
-    total += (B * n) * 7 * 4                       # step table (SMEM)
+    total += (B * n) * (9 if quantized else 7) * 4  # step table (SMEM)
     return int(total)

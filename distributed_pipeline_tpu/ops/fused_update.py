@@ -53,7 +53,31 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["fused_adamw_ema", "update_hbm_bytes"]
+__all__ = ["fused_adamw_ema", "update_hbm_bytes", "resolve_fused_update"]
+
+_TRUE = ("true", "on", "yes", "1")
+_FALSE = ("false", "off", "no", "0")
+
+
+def resolve_fused_update(val: Any) -> bool:
+    """Resolve the tri-state ``--fused_update`` flag to a concrete bool.
+
+    ``"auto"`` (the default since ISSUE 20) means "fused on TPU, staged
+    optax elsewhere": on TPU the one-pass kernel is the measured win
+    (bench leg gpt2-train-fused-update), while off-TPU interpreter mode
+    is pure overhead. Bools and the usual true/false spellings still
+    parse so existing argv and call sites keep working.
+    """
+    if isinstance(val, bool):
+        return val
+    s = str(val).strip().lower()
+    if s == "auto":
+        return jax.default_backend() == "tpu"
+    if s in _TRUE:
+        return True
+    if s in _FALSE:
+        return False
+    raise ValueError(f"fused_update must be auto/true/false, got {val!r}")
 
 LANES = 128
 _BLOCK_ROWS = 256  # rows per grid step: 256x128 f32 = 128 KiB per operand

@@ -127,6 +127,18 @@ def _generator(settings: ServeSettings, default: str = "poisson"):
         diurnal_floor=settings.diurnal_floor)
 
 
+def _quantize_for_serving(settings: ServeSettings, params):
+    """--serve_quant int8: round-trip the replica's weights through int8
+    storage quantization (serving/quantize.py). Raises QuantizationError
+    on a corrupt/pathological tree — at initial load that fails the
+    worker before ready; inside a hot-swap restore it fails the swap ack,
+    so the r13 canary keeps a bad quantization off the fleet."""
+    if settings.serve_quant == "off":
+        return params
+    from ..serving.quantize import quantize_params
+    return quantize_params(params)
+
+
 def _resolve_chaos_plan(settings: ServeSettings):
     """--chaos_plan flag or the DPT_CHAOS_PLAN env (the launcher channel
     training uses); None when neither is set."""
@@ -159,6 +171,7 @@ def _serve_single(settings: ServeSettings) -> dict:
     mesh = make_mesh()
     wl, params, _targs, step, which = load_run(
         settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
+    params = _quantize_for_serving(settings, params)
 
     max_len = settings.max_len or wl.seq_len
     max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
@@ -174,7 +187,11 @@ def _serve_single(settings: ServeSettings) -> dict:
         eos_id=settings.eos_id if settings.eos_id >= 0 else None,
         mesh=mesh, sanitize=settings.sanitize,
         prefix_cache=settings.prefix_cache,
-        decode_impl=settings.decode_impl)
+        decode_impl=settings.decode_impl,
+        kv_quant=settings.kv_quant,
+        spec_tokens=settings.spec_tokens,
+        spec_draft=settings.spec_draft,
+        draft_layers=settings.draft_layers)
 
     pending = _load_requests(settings, max_prompt_len, wl.model.vocab_size)
     logger.info(f"serving {len(pending)} requests on {settings.decode_slots} "
@@ -261,6 +278,14 @@ def _serve_single(settings: ServeSettings) -> dict:
         "compile_time_s": round(server.compile_time_s, 3),
         "wall_s": round(wall_s, 2),
     }
+    if settings.spec_tokens > 0:
+        # every fetched token is target-verified, so the accepted rate IS
+        # the service rate; accept_rate is the draft's hit rate (the
+        # dispatch-amortization lever)
+        result["spec_tokens"] = settings.spec_tokens
+        result["accept_rate"] = round(server.accept_rate, 4)
+        result["accepted_tokens_per_s"] = result[
+            "decode_tokens_per_s_per_chip"]
     result.update(server.prefix_stats())
     if settings.cost_ledger:
         # roofline attribution off the live executables (obs/ledger.py);
@@ -310,6 +335,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     mesh = make_mesh()
     wl, params, _targs, step, _which = load_run(
         settings.checkpoint_path, step, settings.ema, mesh=mesh)
+    params = _quantize_for_serving(settings, params)
     # abstract restore target for hot-swap restores: the SAME concrete-
     # sharding construction the initial load used (one owner —
     # run/sample.restore_target), so a swapped tree restores on any
@@ -331,11 +357,18 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
         eos_id=settings.eos_id if settings.eos_id >= 0 else None,
         mesh=mesh, sanitize=settings.sanitize,
         prefix_cache=settings.prefix_cache,
-        decode_impl=settings.decode_impl)
+        decode_impl=settings.decode_impl,
+        kv_quant=settings.kv_quant,
+        spec_tokens=settings.spec_tokens,
+        spec_draft=settings.spec_draft,
+        draft_layers=settings.draft_layers)
 
     def _restore_params(target: str):
-        # the abstract target's shardings place the tree during restore
-        return ckpt_lib.restore_checkpoint(target, abstract)
+        # the abstract target's shardings place the tree during restore;
+        # --serve_quant re-quantizes the SWAPPED tree too — a failing
+        # guard raises here, the swap acks not-ok, and the canary aborts
+        return _quantize_for_serving(
+            settings, ckpt_lib.restore_checkpoint(target, abstract))
 
     def _engine_step() -> None:
         """One scheduler step, span-attributed by phase: the prefill-vs-
@@ -415,12 +448,21 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
             prefix_index.popitem(last=False)
 
     def _beacon_extra() -> dict:
-        if not settings.prefix_cache:
-            return {}
-        stats = server.prefix_stats()
-        return {"prefix_index": list(prefix_index),
-                "prefix_hits": int(stats.get("prefix_hits", 0)),
-                "prefix_misses": int(stats.get("prefix_misses", 0))}
+        extra = {}
+        if settings.prefix_cache:
+            stats = server.prefix_stats()
+            extra.update({"prefix_index": list(prefix_index),
+                          "prefix_hits": int(stats.get("prefix_hits", 0)),
+                          "prefix_misses": int(
+                              stats.get("prefix_misses", 0))})
+        if settings.spec_tokens > 0:
+            # live speculative gauges per replica (ISSUE 20 satellite:
+            # run/status.py + Prometheus read these off the fleet dir)
+            extra["accept_rate"] = round(server.accept_rate, 4)
+            extra["accepted_tokens_per_s"] = round(
+                server.tokens_fetched
+                / max(time.perf_counter() - t_serve0, 1e-9), 1)
+        return extra
 
     proto.write_beacon(tick)
     proto.announce_ready(step)
@@ -461,7 +503,7 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
         with proto.tracker.timed("swap_s"):
             proto.write_beacon(tick)  # restore time is not a hang
             try:
-                server.engine.params = _restore_params(cmd["target"])
+                server.set_params(_restore_params(cmd["target"]))
                 ok, err = True, ""
             except Exception as e:  # corrupt/missing payload: keep old
                 ok, err = False, f"{type(e).__name__}: {e}"
@@ -522,6 +564,8 @@ def _fleet_worker_main(settings: ServeSettings) -> dict:
     summary = {"ticks": tick, "admitted": admitted, "completed": completed,
                "tokens": tokens_out, "params_step": current_step[0],
                **server.prefix_stats()}
+    if settings.spec_tokens > 0:
+        summary["accept_rate"] = round(server.accept_rate, 4)
     proto.write_sidecar(summary)
     proto.close()  # data-plane endpoint down AFTER the final results
     #                were drained by the router (it polls until all done)
@@ -557,6 +601,8 @@ def _disagg_prefill_main(settings: ServeSettings) -> dict:
     mesh = make_mesh()
     wl, params, _targs, step, _which = load_run(
         settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
+    params = _quantize_for_serving(settings, params)  # deterministic:
+    # prefill and decode tiers quantize the same checkpoint identically
     max_len = settings.max_len or wl.seq_len
     max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
     pre = PrefillClient(
@@ -676,6 +722,15 @@ def _disagg_decode_main(settings: ServeSettings) -> dict:
     mesh = make_mesh()
     wl, params, _targs, step, _which = load_run(
         settings.checkpoint_path, settings.step, settings.ema, mesh=mesh)
+    params = _quantize_for_serving(settings, params)
+    if settings.kv_quant != "fp" or settings.spec_tokens > 0:
+        # the prefill->decode KV wire frames are fp and the spec draft's
+        # prefill mirror rides the colocated _admit path — neither is
+        # plumbed through the disagg transfer, so downgrade loudly
+        # instead of serving a silently-mismatched pool
+        print(f"[disagg-decode {settings.replica_id}] --kv_quant/"
+              f"--spec_tokens are colocated-serving features; running "
+              f"fp non-speculative", file=sys.stderr, flush=True)
     max_len = settings.max_len or wl.seq_len
     max_prompt_len = settings.max_prompt_len or max(2, max_len // 2)
     server = DecodeServer(

@@ -32,6 +32,12 @@ __all__ = ["fleet_status", "main", "pipeline_status", "render",
            "run_status", "status"]
 
 
+def _num(v: Any, ndigits: int) -> Optional[float]:
+    """round() when the value is a real number, None otherwise (missing
+    telemetry renders as '-' in the table, never a fabricated 0)."""
+    return round(float(v), ndigits) if isinstance(v, (int, float)) else None
+
+
 def _age(now: float, t: Any) -> Optional[float]:
     try:
         return max(0.0, now - float(t)) if t else None
@@ -234,6 +240,14 @@ def fleet_status(fleet_dir: str, now: Optional[float] = None,
             "tokens_per_s": (round(float(dec["tokens_per_s"]), 1)
                              if isinstance(dec.get("tokens_per_s"),
                                            (int, float)) else None),
+            # speculative gauges (ISSUE 20): ledger row when --cost_ledger
+            # is on, else the live beacon extras — None when not serving
+            # speculatively, so the column reads '-' instead of lying 0
+            "accept_rate": _num(dec.get("accept_rate",
+                                        b.get("accept_rate")), 4),
+            "accepted_tokens_per_s": _num(
+                dec.get("accepted_tokens_per_s",
+                        b.get("accepted_tokens_per_s")), 1),
             "attempts": len(goodput.read_attempts(rd)),
         })
     events = goodput.read_journal(goodput.serving_journal_path(fleet_dir))
@@ -279,7 +293,8 @@ def render(snap: dict) -> str:
         headers = ["replica", "state", "attempt", "params_step", "tick",
                    "beacon_age_s", "in_flight", "serving_s", "drain_s",
                    "swap_s", "prefix_hit_rate", "mfu",
-                   "mfu_gap_memory_bound", "tokens_per_s", "attempts"]
+                   "mfu_gap_memory_bound", "tokens_per_s", "accept_rate",
+                   "accepted_tokens_per_s", "attempts"]
         out.append(_table(headers, [[r.get(h) for h in headers]
                                     for r in snap["replicas"]]))
         out.append(

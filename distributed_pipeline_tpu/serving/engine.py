@@ -103,7 +103,8 @@ class DecodeEngine:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng: Optional[jax.Array] = None, seed: int = 0,
                  mesh=None, transfer_guard: bool = False,
-                 decode_impl: str = "auto",
+                 decode_impl: str = "auto", kv_quant: str = "fp",
+                 spec_tokens: int = 0,
                  on_compile: Optional[Callable[[str, float], None]] = None):
         model = workload.model
         if workload.family != "gpt2":
@@ -131,6 +132,12 @@ class DecodeEngine:
         if decode_span < 1:
             raise ValueError(f"decode_span must be >= 1, got {decode_span}")
         self.decode_span = decode_span
+        if spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        self.spec_tokens = spec_tokens
+        if kv_quant not in ("fp", "int8"):
+            raise ValueError(f"kv_quant must be fp|int8, got {kv_quant!r}")
+        self.kv_quant = kv_quant
         if max_pages < 2:
             raise ValueError(f"max_pages must be >= 2 (page 0 is the trash "
                              f"page), got {max_pages}")
@@ -148,7 +155,7 @@ class DecodeEngine:
         # ROADMAP-reserved seam (ops/flash_decode.py dispatch rules)
         dm = model.clone(decode=True, moe_no_drop=True,
                          paged_pages=max_pages, page_size=page_size,
-                         decode_impl=decode_impl)
+                         decode_impl=decode_impl, kv_quant=kv_quant)
         pick = _slot_picker(temperature, top_k, top_p)
 
         def prefill_fn(p, cache, ids, prompt_lens, slot_map, slot_tables,
@@ -212,6 +219,50 @@ class DecodeEngine:
                 body, (cache, tokens, positions), None, length=decode_span)
             return cache, tokens, positions, seq
 
+        def verify_fn(p, cache, draft, tokens, positions, block_table,
+                      active, key):
+            """Speculative verify: ONE forward runs the whole chain
+            ``[current, draft_1..draft_K]`` as a length-(K+1) span through
+            the model (backbone span branch) and returns the target's pick
+            at every link — [K+1, S]. Every link's K/V is written at its
+            own position before the B*(K+1) pseudo-slot attention reads
+            the live prefix plus the earlier links — the same rows a
+            sequential K+1-step replay would read, at the op count of ONE
+            decode step (this is speculative decoding's wall-clock win;
+            the earlier lax.scan formulation cost K+1 sequential model
+            applies and could never beat its non-speculative twin on an
+            op-bound backend). Row j's pick folds per (slot, position)
+            exactly like decode_fn, so the accepted stream is
+            token-identical to the non-speculative path, greedy or
+            sampled (scheduler acceptance walk). Rejected links' writes
+            land past the live position in the slot's own reserved pages
+            (the decode-span overshoot contract); budget-final overshoot
+            past the position table clamps to the last addressable cell
+            inside the span writers (serving/paged_kv.py) rather than
+            wrapping into a live lower cell — clamped picks are always
+            past-budget and discarded by the host walk. State vectors are
+            NOT threaded back: the host owns rollback and pushes (token,
+            position) before every round (set_decode_state); inactive
+            slots' picks are garbage the scheduler never attributes."""
+            del active  # state is host-pushed; dead rows discard at fetch
+            kp1 = spec_tokens + 1
+            chain = jnp.concatenate(
+                [tokens[:, None], draft.T.astype(tokens.dtype)], axis=1)
+            logits, mvars = dm.apply({**p, "cache": cache}, chain, None,
+                                     cache_index=positions,
+                                     block_table=block_table,
+                                     mutable=["cache"])
+            # one flattened pick over all S*(K+1) rows: the fold is still
+            # per (slot, position), so each row picks exactly what the
+            # sequential path would at that coordinate
+            pos_f = (positions[:, None] + 1
+                     + jnp.arange(kp1, dtype=jnp.int32)[None, :])
+            slot_f = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32)[:, None], (s, kp1))
+            seq = pick(logits.reshape(s * kp1, -1), pos_f.reshape(-1),
+                       slot_f.reshape(-1), key).reshape(s, kp1).T
+            return mvars["cache"], seq
+
         # Cache structure WITHOUT compiling an init variant: eval_shape the
         # first-call (variable-creating) apply, then zero-fill. Every real
         # prefill/decode then shares one with-cache signature.
@@ -248,6 +299,17 @@ class DecodeEngine:
             jax.jit(decode_fn, donate_argnums=(1,), **okw_d),
             "serve_decode", on_compile=self._note_compile,
             pin_signature=True)
+        self._verify_step = None
+        if spec_tokens > 0:
+            okw_v: dict = {}
+            if mesh is not None:
+                rep = replicated(mesh)
+                cache_rep = jax.tree_util.tree_map(lambda _: rep, cache_abs)
+                okw_v["out_shardings"] = (cache_rep, rep)
+            self._verify_step = AOTStep(
+                jax.jit(verify_fn, donate_argnums=(1,), **okw_v),
+                "serve_verify", on_compile=self._note_compile,
+                pin_signature=True)
 
         # Device state (functional chain; cache is donated through it).
         # Eager construction happens HERE, at wiring time — dispatches later
@@ -274,7 +336,10 @@ class DecodeEngine:
         the cost ledger (obs/ledger.py) extracts ``cost_analysis()``/
         HLO text from (each wrapper's ``.compiled`` is None until its
         first dispatch builds it)."""
-        return {"prefill": self._prefill_step, "decode": self._decode_step}
+        out = {"prefill": self._prefill_step, "decode": self._decode_step}
+        if self._verify_step is not None:
+            out["verify"] = self._verify_step
+        return out
 
     def _put(self, x: np.ndarray) -> jax.Array:
         if self.mesh is not None:
@@ -327,7 +392,18 @@ class DecodeEngine:
         return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat
                 if (getattr(leaf, "ndim", 0) == 4
                     and leaf.shape[0] == self.max_pages
-                    and leaf.shape[1] == self.page_size)]
+                    and leaf.shape[1] == self.page_size)
+                # int8 pools: the [P] per-page scale sidecars are page
+                # state too — they ride the same extract/ingest wire
+                or (getattr(leaf, "ndim", 0) == 1
+                    and leaf.shape[0] == self.max_pages)]
+
+    def kv_pool_bytes(self) -> int:
+        """Device bytes the paged KV pool holds (pages + scale sidecars,
+        every layer) — the ledger's page-pool gauge: the int8 arm must
+        land at <= 0.55x the fp arm at equal geometry (ISSUE 20)."""
+        return int(sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                       for _, leaf in self._pool_leaves()))
 
     def extract_pages(self, page_ids: np.ndarray) -> Dict[str, np.ndarray]:
         """Pull the contents of ``page_ids`` out of every pool leaf as
@@ -377,6 +453,16 @@ class DecodeEngine:
         self.tokens = self._put(toks)
         self.positions = self._put(pos)
 
+    def set_decode_state(self, tokens: np.ndarray,
+                         positions: np.ndarray) -> None:
+        """Push the full [S] (token, position) state from host mirrors —
+        the speculative scheduler's rollback primitive: after a partial
+        rejection the host simply declares the post-acceptance state
+        before the next round's dispatch (the device vectors advanced
+        through the whole draft inside verify and are never read back)."""
+        self.tokens = self._put(np.ascontiguousarray(tokens, np.int32))
+        self.positions = self._put(np.ascontiguousarray(positions, np.int32))
+
     # ------------------------------------------------------------- phases
 
     def prefill(self, ids: np.ndarray, prompt_lens: np.ndarray,
@@ -405,3 +491,27 @@ class DecodeEngine:
                 self.params, self.cache, self.tokens, self.positions,
                 self._block_table, self._active, self._key)
         return toks
+
+    def verify(self, draft: np.ndarray, tokens: Optional[np.ndarray] = None,
+               positions: Optional[np.ndarray] = None) -> jax.Array:
+        """Speculatively verify a [spec_tokens, S] draft in one dispatch.
+        Returns the [spec_tokens + 1, S] target-pick handle; the host
+        walks acceptance. ``tokens``/``positions`` [S] declare the round's
+        (current token, position) state straight from the host mirrors —
+        rollback after a partial rejection is just declaring the
+        post-acceptance state here, no separate :meth:`set_decode_state`
+        push (the device vectors advanced through the whole prior draft
+        inside verify and are never read back). Omitted, the engine's own
+        state vectors are used (decode interleave)."""
+        if self._verify_step is None:
+            raise RuntimeError("engine built with spec_tokens=0")
+        with self._ctx():
+            self.cache, seq = self._verify_step(
+                self.params, self.cache,
+                self._put(np.ascontiguousarray(draft, np.int32)),
+                self.tokens if tokens is None else self._put(
+                    np.ascontiguousarray(tokens, np.int32)),
+                self.positions if positions is None else self._put(
+                    np.ascontiguousarray(positions, np.int32)),
+                self._block_table, self._active, self._key)
+        return seq

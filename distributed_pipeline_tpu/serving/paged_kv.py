@@ -44,9 +44,13 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["TRASH_PAGE", "gather_kv", "write_prompt_kv", "write_token_kv",
-           "PageManager", "PrefixCache"]
+           "write_span_kv", "write_prompt_kv_q8", "write_token_kv_q8",
+           "write_span_kv_q8", "dequant_gathered", "PageManager",
+           "PrefixCache"]
 
 TRASH_PAGE = 0  # reserved: masked/invalid writes land here, reads never do
+
+Q8_MAX = 127.0  # symmetric int8: value = q * scale, q in [-127, 127]
 
 
 def gather_kv(pages: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
@@ -93,6 +97,149 @@ def write_token_kv(pages: jnp.ndarray, block_table: jnp.ndarray,
     page_idx = jnp.minimum(positions // ps, block_table.shape[1] - 1)
     phys = jnp.take_along_axis(block_table, page_idx[:, None], axis=1)[:, 0]
     return pages.at[phys, positions % ps].set(kv)
+
+
+def write_span_kv(pages: jnp.ndarray, block_table: jnp.ndarray,
+                  kv: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
+    """Scatter a speculative-verify span's K (or V) rows.
+
+    ``kv`` [B, H, L, Dh] holds each slot's chain links at positions
+    ``start[b]..start[b]+L-1``; positions past the block table's reach
+    clamp to the LAST addressable cell instead of wrapping through the
+    OOB-clamped page lookup into a live lower cell (``pos // page_size``
+    clamps to the last table column while ``pos % page_size`` re-enters
+    at offset 0). Clamped links are always past a slot's budget-final
+    position: their picks are discarded by the host acceptance walk and
+    the cell they land in is either never queried again or overwritten
+    by the next legitimate feed before any query reads it, so a clamp
+    collision's last-write-wins nondeterminism can never reach an
+    accepted token."""
+    b, h, l, dh = kv.shape
+    ps = pages.shape[1]
+    pos = start[:, None] + jnp.arange(l, dtype=jnp.int32)[None, :]  # [B, L]
+    pos = jnp.minimum(pos, block_table.shape[1] * ps - 1)
+    phys = jnp.take_along_axis(block_table, pos // ps, axis=1)      # [B, L]
+    rows = kv.transpose(0, 2, 1, 3).reshape(b * l, h, dh)
+    return pages.at[phys.reshape(-1), (pos % ps).reshape(-1)].set(rows)
+
+
+def _q8(rows: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Quantize fp rows to int8 under a per-row ``scale`` (broadcastable).
+    ``scale == 0`` (all-zero content) maps everything to 0."""
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.round(rows.astype(jnp.float32) / s)
+    return jnp.clip(q, -Q8_MAX, Q8_MAX).astype(jnp.int8)
+
+
+def write_prompt_kv_q8(pages: jnp.ndarray, scales: jnp.ndarray,
+                       block_table: jnp.ndarray, kv: jnp.ndarray,
+                       valid: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """int8 twin of :func:`write_prompt_kv`: quantize a prefill's K (or V)
+    rows at page granularity and SET each touched page's scale.
+
+    ``pages`` is the int8 pool, ``scales`` the [P] fp32 sidecar. A touched
+    page's scale becomes ``absmax(its prompt rows) / 127`` — SET, not
+    max-accumulated against the leftover scale of whatever request used the
+    page before, so quantization is a pure function of prompt content and a
+    shared-prefix page is rewritten identically by every sharing prefill
+    (the PrefixCache soundness argument survives quantization: same tokens
+    -> same rows -> same scale -> same int8 bits). Untouched pages (and the
+    trash page, which every prefill scribbles on) keep their scales: the
+    trash scale is garbage, but no read ever maps it."""
+    b, h, l, dh = kv.shape
+    ps = pages.shape[1]
+    pos = jnp.arange(l, dtype=jnp.int32)
+    page_idx = jnp.minimum(pos // ps, block_table.shape[1] - 1)
+    phys = block_table[:, page_idx]               # [B, L]
+    phys = jnp.where(valid > 0, phys, TRASH_PAGE).reshape(-1)
+    rows = kv.transpose(0, 2, 1, 3).reshape(b * l, h, dh)
+    row_amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=(1, 2))
+    fresh = jnp.zeros_like(scales).at[phys].max(row_amax / Q8_MAX)
+    touched = jnp.zeros_like(scales, dtype=jnp.int32).at[phys].max(1)
+    # trash writes must not perturb the (meaningless but live-indexed)
+    # trash scale between dispatches of differently-padded batches
+    touched = touched.at[TRASH_PAGE].set(0)
+    new_scales = jnp.where(touched > 0, fresh, scales)
+    off = jnp.broadcast_to(pos % ps, (b, l)).reshape(-1)
+    q = _q8(rows, new_scales[phys][:, None, None])
+    return pages.at[phys, off].set(q), new_scales
+
+
+def write_token_kv_q8(pages: jnp.ndarray, scales: jnp.ndarray,
+                      block_table: jnp.ndarray, kv: jnp.ndarray,
+                      positions: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """int8 twin of :func:`write_token_kv` with rescale-on-grow.
+
+    A decode write may exceed its page's current scale; clipping there
+    would be an unbounded relative error, so instead the page's scale grows
+    to ``max(old, absmax(row)/127)`` and the page's EXISTING int8 content
+    is re-expressed under the new scale (``q * old/new``, rounded — a
+    bounded re-rounding of already-quantized values). This is a gather/
+    rewrite of B pages per step, but those are exactly the pages the
+    attention read is about to DMA anyway, so the traffic stays O(live
+    pages), matching the ``decode_hbm_bytes`` census."""
+    ps = pages.shape[1]
+    page_idx = jnp.minimum(positions // ps, block_table.shape[1] - 1)
+    phys = jnp.take_along_axis(block_table, page_idx[:, None], axis=1)[:, 0]
+    row_amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=(1, 2))  # [B]
+    old = scales[phys]
+    new = jnp.maximum(old, row_amax / Q8_MAX)
+    ratio = jnp.where(new > 0, old / jnp.where(new > 0, new, 1.0), 0.0)
+    page = pages[phys].astype(jnp.float32)        # [B, ps, H, Dh]
+    page = jnp.clip(jnp.round(page * ratio[:, None, None, None]),
+                    -Q8_MAX, Q8_MAX).astype(jnp.int8)
+    page = page.at[jnp.arange(phys.shape[0]), positions % ps].set(
+        _q8(kv, new[:, None, None]))
+    # duplicate phys ids only ever happen on the trash page (inactive
+    # slots) — last-write-wins there is fine, nothing reads it
+    return pages.at[phys].set(page), scales.at[phys].set(new)
+
+
+def write_span_kv_q8(pages: jnp.ndarray, scales: jnp.ndarray,
+                     block_table: jnp.ndarray, kv: jnp.ndarray,
+                     start: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """int8 twin of :func:`write_span_kv` with rescale-on-grow.
+
+    Span rows may straddle a page boundary, so several rows can land in
+    one page; scales grow by deterministic scatter-max (``max(old,
+    absmax(row)/127)`` over every row landing in the page) and existing
+    pool content is re-expressed under the grown scales with a full-pool
+    elementwise pass — pages whose scale did not grow see ratio 1.0 and
+    ``round(q * 1.0)`` leaves their bits untouched, so this is
+    mathematically the same per-page rewrite as write_token_kv_q8, just
+    O(pool) compute instead of O(touched pages). Verify dispatches are
+    span-granular (one per K-token round), so the extra traffic
+    amortizes; swap to a page-set scatter if TPU profiles object."""
+    b, h, l, dh = kv.shape
+    ps = pages.shape[1]
+    pos = start[:, None] + jnp.arange(l, dtype=jnp.int32)[None, :]  # [B, L]
+    pos = jnp.minimum(pos, block_table.shape[1] * ps - 1)
+    phys = jnp.take_along_axis(block_table, pos // ps, axis=1).reshape(-1)
+    rows = kv.transpose(0, 2, 1, 3).reshape(b * l, h, dh)
+    row_amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=(1, 2))
+    new_scales = scales.at[phys].max(row_amax / Q8_MAX)
+    ratio = jnp.where(new_scales > 0,
+                      scales / jnp.where(new_scales > 0, new_scales, 1.0),
+                      0.0)
+    pages = jnp.clip(jnp.round(pages.astype(jnp.float32)
+                               * ratio[:, None, None, None]),
+                     -Q8_MAX, Q8_MAX).astype(jnp.int8)
+    q = _q8(rows, new_scales[phys][:, None, None])
+    return pages.at[phys, (pos % ps).reshape(-1)].set(q), new_scales
+
+
+def dequant_gathered(dense: jnp.ndarray, scales: jnp.ndarray,
+                     block_table: jnp.ndarray, page_size: int,
+                     dtype: jnp.dtype) -> jnp.ndarray:
+    """Dequantize a :func:`gather_kv` result: ``dense`` [B, H, n*ps, Dh]
+    int8 -> ``dtype``, scaling each position by its source page's scale
+    (``scales[block_table]`` broadcast across the page's rows)."""
+    per_page = scales[block_table]                # [B, n]
+    per_pos = jnp.repeat(per_page, page_size, axis=1)  # [B, n*ps]
+    return (dense.astype(jnp.float32)
+            * per_pos[:, None, :, None]).astype(dtype)
 
 
 class PageManager:

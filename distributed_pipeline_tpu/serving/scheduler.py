@@ -46,6 +46,7 @@ from ..utils.perf import transformer_decode_flops_per_token \
     as decode_flops_per_token
 from .engine import DecodeEngine
 from .paged_kv import TRASH_PAGE, PageManager, PrefixCache
+from .spec import DRAFT_KINDS, ngram_propose, truncated_draft
 
 __all__ = ["Request", "DecodeServer", "one_shot_decode"]
 
@@ -92,6 +93,16 @@ class DecodeServer:
     ``recompile_count`` (steady state must freeze it — the two phase
     executables compile exactly once) and dispatches run under
     ``jax.transfer_guard("disallow")``.
+
+    ``spec_tokens = K > 0`` turns on SPECULATIVE decoding: each round a
+    draft (``spec_draft``: host-side "ngram" prompt-lookup, or "model" — an
+    early-exit engine over the target's first ``draft_layers`` blocks)
+    proposes K tokens per slot and ONE verify dispatch yields the target's
+    pick at every link (serving/spec.py for the acceptance contract —
+    greedy output is token-identical to the non-speculative path). Spec
+    rounds are synchronous (the verify result IS next round's input), so
+    ``dispatch_lag`` overlap doesn't apply; the win is K+1 target steps
+    per dispatch, paid back at the accept rate.
     """
 
     def __init__(self, workload, params, *, decode_slots: int = 8,
@@ -104,7 +115,9 @@ class DecodeServer:
                  mesh=None, sanitize: bool = False,
                  dispatch_lag: int = 1,
                  prefix_cache: bool = False,
-                 decode_impl: str = "auto") -> None:
+                 decode_impl: str = "auto", kv_quant: str = "fp",
+                 spec_tokens: int = 0, spec_draft: str = "ngram",
+                 draft_layers: int = 2) -> None:
         max_len = max_len or workload.seq_len
         max_prompt_len = max_prompt_len or max(2, max_len // 2)
         pages_per_slot = -(-max_len // page_size)
@@ -123,6 +136,12 @@ class DecodeServer:
         self._sanitizer_reported = False
         if sanitize:
             self._recompiles.install()
+        if spec_tokens > 0 and spec_draft not in DRAFT_KINDS:
+            raise ValueError(f"spec_draft must be one of {DRAFT_KINDS}, "
+                             f"got {spec_draft!r}")
+        self.spec_tokens = spec_tokens
+        self.spec_draft = spec_draft
+        self._draft_layers = draft_layers
         try:
             self.engine = DecodeEngine(
                 workload, params, decode_slots=decode_slots,
@@ -131,7 +150,35 @@ class DecodeServer:
                 prefill_batch=prefill_batch, decode_span=decode_span,
                 temperature=temperature,
                 top_k=top_k, top_p=top_p, rng=rng, seed=seed, mesh=mesh,
-                transfer_guard=sanitize, decode_impl=decode_impl)
+                transfer_guard=sanitize, decode_impl=decode_impl,
+                kv_quant=kv_quant, spec_tokens=spec_tokens)
+            self._draft_engine: Optional[DecodeEngine] = None
+            self._draft_fpt = 0.0
+            if spec_tokens > 0 and spec_draft == "model":
+                # Early-exit draft over the target's first draft_layers
+                # blocks, on a STATIC full-residency pool: slot s owns
+                # pages [1 + s*pps, 1 + (s+1)*pps) forever, so the draft
+                # needs no allocator and rollback is just the host state
+                # push each round (accepted draft K/V is valid by the
+                # acceptance rule: d_j == g_{j-1}).
+                dwl, dparams = truncated_draft(workload, params,
+                                               draft_layers)
+                pps = self.engine.pages_per_slot
+                self._draft_engine = DecodeEngine(
+                    dwl, dparams, decode_slots=decode_slots,
+                    page_size=page_size,
+                    max_pages=1 + decode_slots * pps,
+                    max_prompt_len=max_prompt_len, max_len=max_len,
+                    prefill_batch=prefill_batch, decode_span=1,
+                    temperature=0.0, seed=seed, mesh=mesh,
+                    transfer_guard=sanitize, decode_impl=decode_impl,
+                    kv_quant=kv_quant)
+                self._draft_tables = np.arange(
+                    1, 1 + decode_slots * pps,
+                    dtype=np.int32).reshape(decode_slots, pps)
+                self._draft_engine.set_block_tables(self._draft_tables)
+                self._draft_fpt = decode_flops_per_token(
+                    dwl.param_count(dparams))
         except BaseException:
             self._recompiles.uninstall()  # failed build must not leak the
             raise                         # process-global 'jax' log handler
@@ -169,6 +216,13 @@ class DecodeServer:
         self.prefill_token_slots = 0
         self.slot_steps_active = 0
         self.slot_steps_total = 0
+        # Speculative gauges: per-round draft proposals vs matches (the
+        # fleet accept_rate surface) — every FETCHED token still counts
+        # through tokens_fetched, which in spec mode is by definition the
+        # accepted-token count.
+        self.spec_rounds = 0
+        self.draft_proposed = 0
+        self.draft_accepted = 0
 
     # ----------------------------------------------------------- gauges etc.
 
@@ -204,6 +258,20 @@ class DecodeServer:
         self.stop_sanitizer()
         return self.sanitize_report.write(out_dir)
 
+    def set_params(self, params) -> None:
+        """Hot-swap surface: replace the target's weights AND rebuild the
+        model draft's early-exit views from the swapped tree (the draft
+        leaves are references into ``params``, so this is re-indexing,
+        not a second restore). Callers that poke ``engine.params``
+        directly would leave a model draft proposing from stale weights —
+        harmless for correctness (every token is target-verified) but a
+        silent accept-rate regression."""
+        self.engine.params = params
+        if self._draft_engine is not None:
+            _, dparams = truncated_draft(self.workload, params,
+                                         self._draft_layers)
+            self._draft_engine.params = dparams
+
     @property
     def free_slots(self) -> int:
         return sum(1 for s in self.slots if s is None)
@@ -229,6 +297,15 @@ class DecodeServer:
         self.prefill_token_slots = 0
         self.slot_steps_active = 0
         self.slot_steps_total = 0
+        self.spec_rounds = 0
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+
+    @property
+    def accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the target accepted."""
+        return (self.draft_accepted / self.draft_proposed
+                if self.draft_proposed > 0 else 0.0)
 
     def prefix_stats(self) -> dict:
         """Prefix-cache gauges (empty dict when the cache is off)."""
@@ -270,7 +347,31 @@ class DecodeServer:
                     "tokens_per_s": tokens_per_s,
                     "steps_per_s": steps_per_s,
                     "decode_span": self.engine.decode_span,
+                    # page-pool residency gauge: the int8 KV criterion is
+                    # ledger-verified (int8 arm <= 0.55x fp at equal
+                    # geometry)
+                    "kv_pool_bytes": self.engine.kv_pool_bytes(),
+                    "kv_quant": self.engine.kv_quant,
                 })
+                if self.spec_tokens > 0:
+                    tf = max(1, self.tokens_fetched)
+                    # draft-flops accounting: what the device ACTUALLY
+                    # spent per fetched (= accepted) token — verify runs
+                    # K+1 target steps per round and the draft its own
+                    # model (0 flops for ngram) — so the roofline stays
+                    # honest about speculative overhead
+                    row.update({
+                        "spec_tokens": self.spec_tokens,
+                        "spec_draft": self.spec_draft,
+                        "accept_rate": self.accept_rate,
+                        "accepted_tokens_per_s": tokens_per_s,
+                        "accepted_tokens_per_s_per_chip":
+                            tokens_per_s / max(1, n_devices),
+                        "draft_flops_per_token": self._draft_fpt,
+                        "spec_flops_per_fetched_token":
+                            fpt * self.slot_steps_active / tf
+                            + self._draft_fpt * self.draft_proposed / tf,
+                    })
                 row.update(ledger_lib.roofline_attribution(
                     tokens_per_s=tokens_per_s, flops_per_token=fpt,
                     peak_flops=device_peak_flops(), n_devices=n_devices,
@@ -491,6 +592,14 @@ class DecodeServer:
             smap[i] = slot
             stables[i] = self.block_tables[slot]
         toks = self.engine.prefill(ids, lens, smap, stables)
+        if self._draft_engine is not None:
+            # mirror the admission into the draft pool (its own static
+            # tables); the draft's first-token pick is irrelevant — every
+            # spec round pushes the authoritative host state first
+            dstables = np.zeros_like(stables)
+            for i, (slot, _) in enumerate(batch):
+                dstables[i] = self._draft_tables[slot]
+            self._draft_engine.prefill(ids, lens, smap, dstables)
         self.prefill_steps += 1
         # padding accounting: actual prompt tokens vs the padded
         # [prefill_batch, max_prompt_len] shape the executable ran at
@@ -532,6 +641,25 @@ class DecodeServer:
         dispatched = False
         while self._admit():
             dispatched = True
+        if self.spec_tokens > 0:
+            # speculative path: synchronous rounds (the verify result IS
+            # next round's input), so drain the prefill ring first — the
+            # round needs every slot's current token host-side — and
+            # sweep any EOS the fetch flagged before dispatching
+            if self._ring:
+                self._fetch(0)
+            if self._needs_sweep:
+                for slot, st in enumerate(self.slots):
+                    if st is not None and st.req.finished:
+                        self._release(slot)
+                self._needs_sweep = False
+            if self.active.any():
+                self._spec_round()
+                dispatched = True
+            if self.sanitize and self._recompiles_at_first_token is None \
+                    and self.tokens_fetched > 0:
+                self._recompiles_at_first_token = self._recompiles.count
+            return dispatched
         if self.active.any():
             if self._dirty:
                 self.engine.set_block_tables(self.block_tables)
@@ -568,6 +696,80 @@ class DecodeServer:
             # was warmup; growth beyond this snapshot is a violation
             self._recompiles_at_first_token = self._recompiles.count
         return dispatched or bool(self._ring)
+
+    def _spec_round(self) -> None:
+        """One speculative round: propose K -> verify in one dispatch ->
+        walk acceptance -> roll back host mirrors. Page/slot bookkeeping
+        is untouched relative to the sequential path: pages were reserved
+        worst-case at admission, rejected links only wrote rows past the
+        live position inside those reserved pages (or trash), and the
+        rolled-back position masks them until they are overwritten — the
+        decode-span overshoot contract, so no leak is possible (tested:
+        tests/test_spec_decode.py)."""
+        if self._dirty:
+            self.engine.set_block_tables(self.block_tables)
+            self.engine.set_active(self.active)
+            if self._draft_engine is not None:
+                self._draft_engine.set_active(self.active)
+            self._dirty = False
+        S = len(self.slots)
+        K = self.spec_tokens
+        cur_tok = np.zeros((S,), np.int32)
+        cur_pos = np.zeros((S,), np.int32)
+        snap: List[tuple] = []
+        for s, st in enumerate(self.slots):
+            if st is None or not self.active[s]:
+                continue
+            cur_tok[s] = st.req.tokens[-1]   # last fetched = current state
+            cur_pos[s] = st.position
+            snap.append((s, st))
+        draft = np.zeros((K, S), np.int32)
+        if self._draft_engine is not None:
+            # chain K greedy draft steps: the draft engine feeds its own
+            # picks (decode_fn advances its state), exactly the chain the
+            # target will verify
+            self._draft_engine.set_decode_state(cur_tok, cur_pos)
+            handles = [self._draft_engine.decode() for _ in range(K)]
+            for j, h in enumerate(handles):
+                draft[j] = np.asarray(jax.device_get(h))
+        else:
+            for s, st in snap:
+                hist = np.concatenate(
+                    [st.req.prompt, np.asarray(st.req.tokens, np.int32)])
+                draft[:, s] = ngram_propose(hist, K)
+        seq = np.asarray(jax.device_get(
+            self.engine.verify(draft, cur_tok, cur_pos)))
+        self.decode_steps += 1
+        self.spec_rounds += 1
+        self.slot_steps_active += len(snap) * (K + 1)
+        self.slot_steps_total += S * (K + 1)
+        for s, st in snap:
+            req = st.req
+            kept = 0
+            matched = 0
+            for j in range(K + 1):
+                tok = int(seq[j, s])
+                # row j is valid only while every earlier draft link
+                # matched; the walk below never reaches an invalid row
+                req.tokens.append(tok)
+                self.tokens_fetched += 1
+                kept += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    req.finished = True     # EOS inside an accepted
+                elif len(req.tokens) >= req.g_max:
+                    req.finished = True     # prefix wins over the draft
+                if req.finished:
+                    break
+                if j < K and int(draft[j, s]) == tok:
+                    matched += 1
+                    continue
+                break                        # first mismatch: reject suffix
+            st.generated += kept
+            st.position += kept
+            self.draft_proposed += K
+            self.draft_accepted += matched
+            if req.finished:
+                self._release(s)
 
     def _fetch(self, lag: int) -> None:
         """Drain the fetch ring down to ``lag`` entries, attributing each

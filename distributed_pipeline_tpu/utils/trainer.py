@@ -49,7 +49,7 @@ from ..data.device_prefetch import DeviceBatch, prefetch_to_device
 from ..models import Workload
 from ..obs import ledger as ledger_lib
 from ..obs import trace as trace_lib
-from ..ops.fused_update import fused_adamw_ema
+from ..ops.fused_update import fused_adamw_ema, resolve_fused_update
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition as partition_lib
 from ..parallel.sharding import (
@@ -130,7 +130,7 @@ class TrainLoop:
         progress_file: str = "",
         recompute_until_step: int = 0,
         shard_optimizer: bool = False,
-        fused_update: bool = False,
+        fused_update: Any = "auto",
         partition_rules: Optional[Sequence[Tuple[str, Any]]] = None,
         trace: Optional[bool] = None,
         profile_steps: str = "",
@@ -268,8 +268,9 @@ class TrainLoop:
         # apply_updates -> one EMA tree-map per rate) for the single-pass
         # Pallas kernel (ops/fused_update.py); losses stay bit-identical
         # and the opt_state pytree keeps optax's structure, so checkpoints
-        # and ZeRO-1 shardings don't care which path wrote them.
-        self.fused_update = fused_update
+        # and ZeRO-1 shardings don't care which path wrote them. The flag
+        # is tri-state ("auto" = fused on TPU only); resolve it once here.
+        self.fused_update = resolve_fused_update(fused_update)
         self.partition_rules = (tuple(partition_rules)
                                 if partition_rules else None)
         self.goodput = GoodputTracker(t0=self._construct_t0)

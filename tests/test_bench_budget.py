@@ -197,7 +197,8 @@ def test_bench_only_exact_match_with_optional_glob():
         "diffuseq-base-seq128", "diffuseq-base-seq128-prefetch",
         "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
         "diffuseq-base-seq128-tune",
-        "gpt2-serve-decode-b64", "gpt2-base-decode-oneshot-b1",
+        "gpt2-serve-decode-b64", "gpt2-serve-spec-decode",
+        "gpt2-serve-decode-int8", "gpt2-base-decode-oneshot-b1",
         "gpt2-serve-fleet-chaos", "gpt2-serve-autoscale")]
     names = lambda got: [n for n, _ in got]
     assert names(bench.select_legs(legs, "diffuseq-base-seq128")) == \
@@ -207,7 +208,7 @@ def test_bench_only_exact_match_with_optional_glob():
          "diffuseq-base-seq128-zero1", "diffuseq-base-seq128-chaos",
          "diffuseq-base-seq128-tune"]
     assert names(bench.select_legs(legs, "*serve-decode*")) == \
-        ["gpt2-serve-decode-b64"]
+        ["gpt2-serve-decode-b64", "gpt2-serve-decode-int8"]
     # the fleet leg must NOT ride the headline glob (it sits after it so
     # a timeout degrades to an error row, never a blocked headline)
     assert names(bench.select_legs(legs, "gpt2-serve-fleet-chaos")) == \

@@ -68,6 +68,11 @@ GATED_PATHS = [
     # outer steps (GL007) and sit next to the one sanctioned pallas_call
     # home — exactly where a stray call outside ops/ would breed (GL012)
     os.path.join(ROOT, "tests", "test_kernels.py"),
+    # the speculative-decode tests drive DecodeServer host loops through
+    # the verify seam (GL007) and handle the int8 pool/scale sidecars
+    # directly — where unpoliced host<->device syncs and stray
+    # quantization math would breed next
+    os.path.join(ROOT, "tests", "test_spec_decode.py"),
 ]
 
 

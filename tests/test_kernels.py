@@ -169,6 +169,25 @@ def test_decode_hbm_bytes_counts_live_pages_only():
     assert shared == 1 * 2 * page + 2 * qo + 2 * 7 * 4
 
 
+def test_decode_hbm_bytes_dedups_shared_pages_across_slots():
+    """ISSUE 20 satellite: dedup is by page-id SET across the whole
+    schedule, not consecutive visits — a PrefixCache page shared by every
+    slot is DMAd once. Hand count: slots [[1,2],[1,3]] both full — the
+    pre-r22 consecutive-only dedup priced page 1 twice (4 page visits);
+    the set census prices the 3 distinct pages."""
+    ps, H, Dh = 4, 2, 8
+    page = ps * H * Dh * 4
+    qo = H * Dh * 4
+    bt = np.asarray([[1, 2], [1, 3]])
+    got = decode_hbm_bytes(bt, np.asarray([7, 7]), ps, H, Dh)
+    assert got == 3 * 2 * page + 2 * 2 * qo + 2 * 2 * 7 * 4
+    # int8 pool: pages priced at 1 byte/elt, q/out stay fp, table widens
+    # to 9 columns for the per-page scale pair
+    q8 = decode_hbm_bytes(bt, np.asarray([7, 7]), ps, H, Dh,
+                          quantized=True)
+    assert q8 == 3 * 2 * (ps * H * Dh) + 2 * 2 * qo + 2 * 2 * 9 * 4
+
+
 # ------------------------------------------- DecodeServer token identity
 
 VOCAB, SEQ = 32, 16
